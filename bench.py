@@ -1,0 +1,130 @@
+"""Benchmark: FL rounds/sec, FedAvg CIFAR-10, 100 clients (BASELINE.md
+primary metric).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``value`` is the rounds/sec of the SPMD fast path (the whole federated
+round — 100 clients × local epochs + weighted-psum aggregation — as one XLA
+program on the available mesh).  ``vs_baseline`` compares against the
+reference *architecture* under identical work: the simulation-faithful
+executor (per-client threaded round loop, the direct analogue of the
+reference's process-per-client design, since the reference itself publishes
+no numbers — BASELINE.md).  The baseline throughput is measured once on this
+machine and cached in ``bench_baseline.json``.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.dirname(__file__)))
+
+WORKERS = 100
+ROUNDS_MEASURED = 3
+TRAIN_SIZE = 6400  # 64 samples/client
+BATCH = 64
+EPOCH = 1
+
+
+def make_config(executor: str, workers: int, train_size: int):
+    from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+
+    return DistributedTrainingConfig(
+        dataset_name="CIFAR10",
+        model_name="densenet40",
+        distributed_algorithm="fed_avg",
+        executor=executor,
+        worker_number=workers,
+        batch_size=BATCH,
+        round=1,
+        epoch=EPOCH,
+        learning_rate=0.1,
+        dataset_kwargs={"train_size": train_size, "val_size": 64, "test_size": 256},
+        save_dir=os.path.join("/tmp", "dls_tpu_bench", executor),
+        log_file=os.path.join("/tmp", "dls_tpu_bench", f"{executor}.log"),
+    )
+
+
+def measure_spmd() -> float:
+    """Rounds/sec of the SPMD whole-round program (after compile warmup)."""
+    import jax
+
+    from distributed_learning_simulator_tpu.parallel.spmd import SpmdFedAvgSession
+    from distributed_learning_simulator_tpu.training import _build_task
+
+    config = make_config("spmd", WORKERS, TRAIN_SIZE)
+    ctx = _build_task(config)
+    session = SpmdFedAvgSession(
+        ctx.config, ctx.dataset_collection, ctx.model_ctx, ctx.engine, ctx.practitioners
+    )
+    global_params = jax.device_put(
+        ctx.engine.init_params(config.seed), session._replicated
+    )
+    weights = jax.device_put(session._select_weights(1), session._client_sharding)
+    rngs = jax.device_put(
+        jax.random.split(jax.random.PRNGKey(0), session.n_slots),
+        session._client_sharding,
+    )
+    # warmup/compile
+    global_params, _ = session._round_fn(global_params, weights, rngs)
+    jax.block_until_ready(jax.tree.leaves(global_params))
+    start = time.monotonic()
+    for _ in range(ROUNDS_MEASURED):
+        global_params, metrics = session._round_fn(global_params, weights, rngs)
+    jax.block_until_ready(jax.tree.leaves(global_params))
+    elapsed = time.monotonic() - start
+    return ROUNDS_MEASURED / elapsed
+
+
+def measure_threaded_baseline() -> float:
+    """Simulation-faithful executor throughput, scaled to WORKERS clients.
+
+    Runs a reduced client count (the threaded path time-multiplexes one
+    chip, so per-round cost is linear in clients) and scales; cached in
+    bench_baseline.json.
+    """
+    cache_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
+    if os.path.isfile(cache_path):
+        with open(cache_path, encoding="utf8") as f:
+            return json.load(f)["threaded_rounds_per_sec"]
+
+    from distributed_learning_simulator_tpu.training import train
+
+    sample_workers = 8
+    config = make_config(
+        "auto", sample_workers, TRAIN_SIZE * sample_workers // WORKERS
+    )
+    # warmup round (compile), then timed round
+    train(config)
+    start = time.monotonic()
+    train(config.replace(save_dir="", log_file=""))
+    per_round_sample = time.monotonic() - start
+    per_round_full = per_round_sample * (WORKERS / sample_workers)
+    rounds_per_sec = 1.0 / per_round_full
+    with open(cache_path, "wt", encoding="utf8") as f:
+        json.dump({"threaded_rounds_per_sec": rounds_per_sec}, f)
+    return rounds_per_sec
+
+
+def main() -> None:
+    value = measure_spmd()
+    try:
+        baseline = measure_threaded_baseline()
+        vs_baseline = value / baseline if baseline > 0 else 0.0
+    except Exception:
+        vs_baseline = 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "fedavg_cifar10_100clients_rounds_per_sec",
+                "value": round(value, 4),
+                "unit": "rounds/sec",
+                "vs_baseline": round(vs_baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""TPU-native federated / distributed-learning framework.
+
+A brand-new, single-controller JAX/XLA re-design of the capabilities of
+``Tzq2doc/distributed_learning_simulator`` (reference layer map in SURVEY.md):
+N federated clients and a central server train and aggregate models over
+rounds.  Instead of one OS process per client exchanging pickled tensor dicts
+through multiprocessing pipes (reference ``simulation_lib/training.py``), the
+clients here are a **mesh axis**: per-client local training runs as one jitted
+SPMD program (``vmap``/``shard_map`` over a ``clients`` axis) and server
+aggregation is a weighted collective over ICI/DCN.
+
+Public entry points mirror the reference's surface:
+
+* :func:`distributed_learning_simulator_tpu.training.train`
+* :class:`distributed_learning_simulator_tpu.config.DistributedTrainingConfig`
+* :mod:`distributed_learning_simulator_tpu.method` — the algorithm registry
+  (fed_avg, fed_obd, fed_paq, sign_SGD, Shapley values, graph FL, ...).
+"""
+
+from .config import DistributedTrainingConfig, load_config, load_config_from_file
+
+__all__ = [
+    "DistributedTrainingConfig",
+    "load_config",
+    "load_config_from_file",
+]
+
+__version__ = "0.1.0"

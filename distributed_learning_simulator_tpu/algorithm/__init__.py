@@ -1,0 +1,5 @@
+from .aggregation_algorithm import AggregationAlgorithm
+from .fed_avg_algorithm import FedAVGAlgorithm
+from .random_dropout_algorithm import RandomDropoutAlgorithm
+
+__all__ = ["AggregationAlgorithm", "FedAVGAlgorithm", "RandomDropoutAlgorithm"]

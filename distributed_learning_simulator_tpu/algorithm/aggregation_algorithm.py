@@ -1,0 +1,117 @@
+"""Server-side aggregation algorithm base.
+
+TPU-native equivalent of
+``simulation_lib/algorithm/aggregation_algorithm.py:9-96``: normalizes
+incoming worker messages (restore deltas onto the old global params,
+``complete()`` partial uploads), tracks skipped workers, and provides the
+weighted-average primitives.  The math runs as jitted device programs over
+jax arrays instead of CPU float64 tensor walks.
+"""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..message import DeltaParameterMessage, Message, ParameterMessage
+from ..ops.pytree import Params
+from ..utils.logging import get_logger
+
+
+class AggregationAlgorithm:
+    def __init__(self, server=None) -> None:
+        self._server = server
+        self._all_worker_data: dict[int, Message] = {}
+        self._skipped_workers: set[int] = set()
+        self._old_parameter_dict: Params | None = None
+        self._config = None
+
+    def set_server(self, server) -> None:
+        self._server = server
+
+    def set_config(self, config) -> None:
+        self._config = config
+
+    @property
+    def all_worker_data(self) -> dict[int, Message]:
+        return self._all_worker_data
+
+    @staticmethod
+    def get_ratios(
+        data_dict: dict[int, float | int], scale: float = 1.0
+    ) -> dict[int, float]:
+        """Dataset-size weights (reference ``get_ratios``)."""
+        total = sum(data_dict.values())
+        assert total > 0
+        return {k: float(v) * scale / total for k, v in data_dict.items()}
+
+    @staticmethod
+    def weighted_avg(
+        all_worker_data: dict[int, ParameterMessage],
+        weights: dict[int, float],
+        key: str = "parameter",
+    ) -> Params:
+        """Fixed-worker-order float32 weighted sum, jit-fused per call.
+
+        The reference accumulates in CPU float64
+        (``fed_avg_algorithm.py:44``); float64 is emulated/slow on TPU, so we
+        use a fixed summation order (sorted worker ids) in float32 — see
+        SURVEY.md §7 hard-part 3.
+        """
+        worker_ids = sorted(all_worker_data)
+        assert worker_ids
+        first = getattr(all_worker_data[worker_ids[0]], key)
+        result: Params = {}
+        for name in first:
+            acc = None
+            for worker_id in worker_ids:
+                value = getattr(all_worker_data[worker_id], key)[name]
+                term = value.astype(jnp.float32) * weights[worker_id]
+                acc = term if acc is None else acc + term
+            result[name] = acc.astype(first[name].dtype)
+        return result
+
+    def process_worker_data(
+        self,
+        worker_id: int,
+        worker_data: Message | None,
+        old_parameter_dict: Params | None = None,
+        save_dir: str = "",
+        **kwargs: Any,
+    ) -> None:
+        """Normalize one worker's upload (reference
+        ``aggregation_algorithm.py:52-71``)."""
+        if worker_data is None:
+            self._skipped_workers.add(worker_id)
+            get_logger().debug("worker %s skipped this round", worker_id)
+            return
+        if old_parameter_dict is not None:
+            self._old_parameter_dict = old_parameter_dict
+        match worker_data:
+            case DeltaParameterMessage():
+                assert self._old_parameter_dict is not None
+                worker_data = worker_data.restore(self._old_parameter_dict)
+            case ParameterMessage():
+                if self._old_parameter_dict is not None:
+                    worker_data.complete(self._old_parameter_dict)
+            case Message():
+                pass
+        self._all_worker_data[worker_id] = worker_data
+
+    def aggregate_worker_data(self) -> Message:
+        raise NotImplementedError
+
+    def clear_worker_data(self) -> None:
+        self._all_worker_data.clear()
+        self._skipped_workers.clear()
+
+    def exit(self) -> None:
+        pass
+
+
+def check_finite(params: Params) -> None:
+    """NaN guard (reference asserts after aggregation,
+    ``aggregation_algorithm.py:49``)."""
+    for name, value in params.items():
+        if not bool(jnp.all(jnp.isfinite(value))):
+            raise FloatingPointError(f"non-finite aggregated parameter {name}")

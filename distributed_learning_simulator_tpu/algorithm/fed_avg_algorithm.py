@@ -1,0 +1,134 @@
+"""FedAvg weighted averaging.
+
+TPU-native equivalent of ``simulation_lib/algorithm/fed_avg_algorithm.py:11-110``:
+dataset-size-weighted average with a **streaming** accumulation mode that
+frees each worker's tensors as they arrive to bound memory, per-name weight
+accumulators (subclasses may return per-element weight arrays — see
+``fed_dropout_avg``), and a batch fallback path.  Accumulation is a jitted
+device add in float32 with fixed arrival order instead of the reference's CPU
+float64 walk (SURVEY.md §7 hard-part 3).
+"""
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..message import Message, ParameterMessage
+from ..ops.pytree import Params
+from ..utils.logging import get_logger
+from .aggregation_algorithm import AggregationAlgorithm, check_finite
+
+
+@jax.jit
+def _acc_add(acc, term):
+    return {k: acc[k] + term[k] for k in acc}
+
+
+class FedAVGAlgorithm(AggregationAlgorithm):
+    def __init__(self, server=None) -> None:
+        super().__init__(server=server)
+        self.accumulate: bool = True
+        self._dtypes: dict[str, Any] = {}
+        self._total_weights: dict[str, Any] = {}
+        self._parameter: Params = {}
+        self._end_training = False
+        self._other_data: dict = {}
+
+    # subclass hooks (reference ``_get_weight`` / ``_apply_total_weight``)
+    def _get_weight(self, dataset_size: int, name: str, parameter: Any) -> Any:
+        assert dataset_size != 0
+        return float(dataset_size)
+
+    def _apply_total_weight(self, name: str, parameter, total_weight):
+        return parameter / total_weight
+
+    def process_worker_data(self, worker_id, worker_data, **kwargs) -> None:
+        super().process_worker_data(worker_id, worker_data, **kwargs)
+        if not self.accumulate:
+            return
+        data = self._all_worker_data.get(worker_id)
+        if not isinstance(data, ParameterMessage):
+            return
+        terms = {}
+        for name, value in data.parameter.items():
+            self._dtypes[name] = value.dtype
+            weight = self._get_weight(
+                dataset_size=data.dataset_size, name=name, parameter=value
+            )
+            term = value.astype(jnp.float32) * weight
+            terms[name] = term
+            if name in self._total_weights:
+                self._total_weights[name] = self._total_weights[name] + weight
+            else:
+                self._total_weights[name] = weight
+        if not self._parameter:
+            self._parameter = terms
+        else:
+            assert set(terms) == set(self._parameter), "inconsistent upload keys"
+            self._parameter = _acc_add(self._parameter, terms)
+        self._end_training |= data.end_training
+        self._merge_other_data(data.other_data)
+        # release worker tensors immediately (reference bounds memory the same
+        # way, fed_avg_algorithm.py:53-54)
+        data.parameter = {}
+
+    def _merge_other_data(self, other_data: dict) -> None:
+        for key, value in other_data.items():
+            if key in self._other_data:
+                if self._other_data[key] != value:
+                    raise RuntimeError(f"different values on key {key}")
+            else:
+                self._other_data[key] = value
+
+    def aggregate_worker_data(self) -> Message:
+        if not self.accumulate:
+            return self._aggregate_worker_data(self._all_worker_data)
+        assert self._parameter, "no worker parameters to aggregate"
+        parameter = self._parameter
+        self._parameter = {}
+        for name, value in parameter.items():
+            averaged = self._apply_total_weight(
+                name=name, parameter=value, total_weight=self._total_weights[name]
+            )
+            parameter[name] = averaged.astype(self._dtypes[name])
+        check_finite(parameter)
+        self._total_weights = {}
+        return ParameterMessage(
+            parameter=parameter,
+            end_training=self._end_training,
+            other_data=dict(self._other_data),
+        )
+
+    @classmethod
+    def _aggregate_worker_data(cls, all_worker_data: dict) -> ParameterMessage:
+        """Batch path (reference ``accumulate=False`` fallback)."""
+        messages = {
+            w: d for w, d in all_worker_data.items() if isinstance(d, ParameterMessage)
+        }
+        assert messages
+        weights = AggregationAlgorithm.get_ratios(
+            {w: d.dataset_size for w, d in messages.items()}
+        )
+        parameter = AggregationAlgorithm.weighted_avg(messages, weights)
+        check_finite(parameter)
+        other: dict = {}
+        for d in messages.values():
+            for k, v in d.other_data.items():
+                if k in other and other[k] != v:
+                    raise RuntimeError(f"different values on key {k}")
+                other[k] = v
+        return ParameterMessage(
+            parameter=parameter,
+            end_training=any(d.end_training for d in messages.values()),
+            other_data=other,
+        )
+
+    def clear_worker_data(self) -> None:
+        super().clear_worker_data()
+        self._parameter = {}
+        self._total_weights = {}
+        self._dtypes = {}
+        self._end_training = False
+        self._other_data = {}

@@ -1,0 +1,73 @@
+"""Graph-FL server algorithm: training-node-index union + boundary-embedding
+routing.
+
+TPU-native equivalent of ``simulation_lib/algorithm/graph_algorithm.py:7-89``
+(``GraphNodeEmbeddingPassingAlgorithm``): in-round messages are either (a)
+per-worker training-node index sets — unioned and rebroadcast — or (b)
+boundary-embedding exchanges — each worker provides embeddings for its nodes
+and declares the node ids it needs; the server indexes all provided rows and
+returns each worker its requested rows.  Parameter rounds fall through to
+FedAvg.
+"""
+
+import numpy as np
+
+from ..message import Message
+from .fed_avg_algorithm import FedAVGAlgorithm
+
+
+class GraphNodeEmbeddingPassingAlgorithm(FedAVGAlgorithm):
+    def __init__(self, server=None) -> None:
+        super().__init__(server=server)
+        self.training_node_indices: dict[int, np.ndarray] = {}
+
+    def aggregate_worker_data(self) -> Message:
+        sample = next(iter(self._all_worker_data.values()), None)
+        if isinstance(sample, Message) and "training_node_indices" in sample.other_data:
+            return self._exchange_training_node_indices()
+        if isinstance(sample, Message) and "node_embedding" in sample.other_data:
+            return self._route_node_embeddings()
+        return super().aggregate_worker_data()
+
+    def _exchange_training_node_indices(self) -> Message:
+        for worker_id, data in self._all_worker_data.items():
+            self.training_node_indices[worker_id] = np.asarray(
+                data.other_data["training_node_indices"]
+            )
+        merged = {w: idx.tolist() for w, idx in self.training_node_indices.items()}
+        worker_result = {
+            w: Message(in_round=True, other_data={"training_node_indices": merged})
+            for w in self._all_worker_data
+        }
+        return Message(in_round=True, other_data={"worker_result": worker_result})
+
+    def _route_node_embeddings(self) -> Message:
+        # index all provided embeddings by global node id
+        provided_rows = []
+        provided_ids = []
+        for data in self._all_worker_data.values():
+            embedding = np.asarray(data.other_data["node_embedding"])
+            node_ids = np.asarray(data.other_data["node_indices"])
+            provided_rows.append(embedding)
+            provided_ids.append(node_ids)
+        all_rows = np.concatenate(provided_rows, axis=0)
+        all_ids = np.concatenate(provided_ids, axis=0)
+        id_to_row = {int(node): i for i, node in enumerate(all_ids)}
+
+        worker_result = {}
+        for worker_id, data in self._all_worker_data.items():
+            wanted = np.asarray(data.other_data["boundary"])
+            available = [int(n) for n in wanted if int(n) in id_to_row]
+            rows = (
+                all_rows[[id_to_row[n] for n in available]]
+                if available
+                else np.zeros((0, all_rows.shape[1]), all_rows.dtype)
+            )
+            worker_result[worker_id] = Message(
+                in_round=True,
+                other_data={
+                    "node_embedding": rows,
+                    "node_indices": np.asarray(available, dtype=np.int32),
+                },
+            )
+        return Message(in_round=True, other_data={"worker_result": worker_result})

@@ -1,0 +1,39 @@
+"""Random whole-tensor dropout of a parameter dict.
+
+TPU-native equivalent of
+``simulation_lib/algorithm/random_dropout_algorithm.py:7-31``: randomly keep
+whole tensors under a ``1 - dropout_rate`` byte budget (building block of the
+``single_model_afd`` method family).
+"""
+
+import random
+
+from ..ops.pytree import Params
+from ..utils.logging import get_logger
+
+
+class RandomDropoutAlgorithm:
+    def __init__(self, dropout_rate: float, seed: int | None = None) -> None:
+        self.dropout_rate = dropout_rate
+        self._rng = random.Random(seed)
+
+    def drop_parameters(self, parameter_dict: Params) -> Params:
+        names = list(parameter_dict.keys())
+        sizes = {k: int(parameter_dict[k].size) for k in names}
+        total = sum(sizes.values())
+        budget = total * (1.0 - self.dropout_rate)
+        self._rng.shuffle(names)
+        kept: Params = {}
+        used = 0
+        for name in names:
+            if used + sizes[name] > budget and kept:
+                continue
+            kept[name] = parameter_dict[name]
+            used += sizes[name]
+        get_logger().debug(
+            "random dropout kept %d/%d tensors (%.2f%% of bytes)",
+            len(kept),
+            len(names),
+            100.0 * used / max(total, 1),
+        )
+        return kept

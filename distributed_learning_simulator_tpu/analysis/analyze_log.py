@@ -1,0 +1,84 @@
+"""Communication-cost analysis.
+
+TPU-native equivalent of ``simulation_lib/analysis/analyze_log.py:14-279``:
+per-algorithm closed-form message counts and byte totals, with the
+fed_obd / fed_dropout_avg / single_model_afd variants discounted by logged
+compression ratios and send counts.  Works from a session directory plus a
+parameter count (the reference scraped run logs with regexes; runs here log
+the same quantities, and the closed forms are exposed directly).
+"""
+
+import dataclasses
+import re
+
+
+@dataclasses.dataclass
+class CommunicationCostModel:
+    parameter_count: int
+    worker_number: int
+    rounds: int
+    dtype_bytes: int = 4
+
+    def fed_avg_bytes(self, selected_per_round: int | None = None) -> int:
+        """Down + up full-parameter transfer per selected client per round,
+        plus the initial distribution (reference closed form,
+        ``analyze_log.py:69-107``)."""
+        clients = selected_per_round or self.worker_number
+        msg_num = 2 * self.rounds * clients + self.worker_number
+        return self.parameter_count * self.dtype_bytes * msg_num
+
+    def fed_paq_bytes(self, quant_bytes: float = 1.0, selected_per_round=None) -> int:
+        clients = selected_per_round or self.worker_number
+        up = self.rounds * clients * self.parameter_count * quant_bytes
+        down = (self.rounds * clients + self.worker_number) * (
+            self.parameter_count * self.dtype_bytes
+        )
+        return int(up + down)
+
+    def fed_obd_bytes(
+        self,
+        dropout_rate: float,
+        compression_ratios: list[float],
+        selected_per_round=None,
+        second_phase_msgs: int = 0,
+    ) -> int:
+        """Phase-1 uploads carry (1-dropout) of the params through the NNADQ
+        codec; broadcasts are quantized too (reference ``analyze_log.py:109-151``)."""
+        clients = selected_per_round or self.worker_number
+        mean_ratio = (
+            sum(compression_ratios) / len(compression_ratios)
+            if compression_ratios
+            else 1.0
+        )
+        per_upload = self.parameter_count * self.dtype_bytes * mean_ratio * (
+            1.0 - dropout_rate
+        )
+        per_broadcast = self.parameter_count * self.dtype_bytes * mean_ratio
+        total = self.rounds * clients * (per_upload + per_broadcast)
+        total += self.worker_number * self.parameter_count * self.dtype_bytes  # init
+        total += second_phase_msgs * per_broadcast
+        return int(total)
+
+    def send_num_bytes(self, send_nums: list[int]) -> int:
+        """fed_dropout_avg / single_model_afd: logged per-upload element
+        counts (reference ``analyze_log.py:191-209``)."""
+        down = self.rounds * self.worker_number * self.parameter_count
+        return int((sum(send_nums) + down) * self.dtype_bytes)
+
+
+_SEND_NUM_RE = re.compile(r"send_num (\d+)")
+_RATIO_RE = re.compile(r"compression ratio: ([0-9.]+)")
+
+
+def scrape_log(path: str) -> dict:
+    """Scrape a run log for send counts and compression ratios (the same
+    quantities the reference's regex scraper extracts)."""
+    send_nums: list[int] = []
+    ratios: list[float] = []
+    with open(path, encoding="utf8", errors="replace") as f:
+        for line in f:
+            if m := _SEND_NUM_RE.search(line):
+                send_nums.append(int(m.group(1)))
+            if m := _RATIO_RE.search(line):
+                ratios.append(float(m.group(1)))
+    return {"send_nums": send_nums, "compression_ratios": ratios}

@@ -1,0 +1,43 @@
+"""Aggregate per-round metrics across sessions
+(reference ``simulation_lib/analysis/analyze_round.py:16-69``: seaborn line
+plots per metric; plotting here is optional — the tabulation is the core)."""
+
+import os
+from collections import defaultdict
+
+from .session import find_sessions
+
+
+def collect_round_metrics(root: str) -> dict[str, dict[int, list[float]]]:
+    """metric name -> round -> values across sessions."""
+    table: dict[str, dict[int, list[float]]] = defaultdict(lambda: defaultdict(list))
+    for session in find_sessions(root):
+        for round_number, stats in session.round_record.items():
+            for metric, value in stats.items():
+                table[metric][round_number].append(value)
+    return {k: dict(v) for k, v in table.items()}
+
+
+def plot_round_metrics(root: str, out_dir: str) -> list[str]:
+    """Write one PNG per metric if matplotlib is available."""
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:  # plotting is optional
+        return []
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for metric, rounds in collect_round_metrics(root).items():
+        xs = sorted(rounds)
+        means = [sum(rounds[x]) / len(rounds[x]) for x in xs]
+        fig, ax = plt.subplots()
+        ax.plot(xs, means, marker="o")
+        ax.set_xlabel("round")
+        ax.set_ylabel(metric)
+        path = os.path.join(out_dir, f"{metric}.png")
+        fig.savefig(path)
+        plt.close(fig)
+        written.append(path)
+    return written

@@ -1,0 +1,35 @@
+"""Per-submodule parameter-drift logging.
+
+TPU-native equivalent of ``simulation_lib/analysis/module_diff.py:8-44``
+(``ModuleDiff`` hook): after each parameter load, log the L2 drift of every
+top-level module block — a debugging aid for aggregation regressions.
+"""
+
+import jax.numpy as jnp
+
+from ..ops.pytree import Params
+from ..utils.logging import get_logger
+
+
+class ModuleDiff:
+    def __init__(self) -> None:
+        self._last: Params | None = None
+
+    def observe(self, params: Params) -> dict[str, float]:
+        drifts: dict[str, float] = {}
+        if self._last is not None:
+            blocks: dict[str, float] = {}
+            for name in params:
+                block = name.split("/")[0]
+                delta = jnp.sum(
+                    jnp.square(
+                        params[name].astype(jnp.float32)
+                        - self._last[name].astype(jnp.float32)
+                    )
+                )
+                blocks[block] = blocks.get(block, 0.0) + float(delta)
+            drifts = {block: value**0.5 for block, value in blocks.items()}
+            for block, value in sorted(drifts.items()):
+                get_logger().debug("module %s drift %.6f", block, value)
+        self._last = dict(params)
+        return drifts

@@ -1,0 +1,223 @@
+"""Config system.
+
+TPU-native equivalent of the reference's ``DistributedTrainingConfig``
+(``simulation_lib/config.py:16-104``) plus the imported surface of the toolbox
+``Config`` it extends (dataset/model/hyper-parameter fields — SURVEY.md §2.2).
+The YAML surface is kept compatible: the same ``conf/<algo>/<dataset>.yaml``
+files, merged under ``conf/global.yaml``, with hydra-style ``++key=value``
+dotted overrides and the reference's single-key-nesting unwrap trick
+(``config.py:93-94``: ``++fed_avg.round=1`` style files).
+"""
+
+import copy
+import dataclasses
+import datetime
+import os
+import uuid
+from typing import Any
+
+import yaml
+
+from .utils.logging import get_logger, set_level
+
+CONF_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "conf")
+
+
+@dataclasses.dataclass
+class DistributedTrainingConfig:
+    # --- dataset / model (toolbox Config surface) ---
+    dataset_name: str = ""
+    model_name: str = ""
+    dataset_kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)
+    model_kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # --- hyper parameters ---
+    optimizer_name: str = "SGD"
+    batch_size: int = 64
+    epoch: int = 1
+    learning_rate: float = 0.01
+    learning_rate_scheduler_name: str = "CosineAnnealingLR"
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    use_amp: bool = False
+    extra_hyper_parameters: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # --- federated fields (reference config.py:16-35) ---
+    distributed_algorithm: str = ""
+    worker_number: int = 1
+    parallel_number: int = 0  # 0 -> number of local devices
+    round: int = 1
+    dataset_sampling: str = "iid"
+    dataset_sampling_kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)
+    distribute_init_parameters: bool = True
+    limited_resource: bool = False
+    endpoint_kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)
+    algorithm_kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)
+    exp_name: str = ""
+    log_file: str = ""
+    # --- global flags (conf/global.yaml) ---
+    cache_transforms: str = "cpu"
+    log_level: str = "INFO"
+    debug: bool = False
+    save_performance_metric: bool = False
+    use_slow_performance_metrics: bool = False
+    merge_validation_to_training_set: bool = False
+    # --- framework-specific (TPU build) ---
+    seed: int = 0
+    executor: str = "auto"  # auto | spmd | sequential
+    save_dir: str = ""
+    checkpoint_every_round: bool = True
+
+    def load_config_and_process(self, overrides: dict[str, Any] | None = None) -> None:
+        """Derive ``save_dir``/``log_file`` the way the reference does
+        (``config.py:36-54``: ``session/<algo>/<dataset>_<sampling>/<model>/<date>/<uuid>``)."""
+        if overrides:
+            apply_overrides(self, overrides)
+        if not self.save_dir:
+            date = datetime.datetime.now().strftime("%Y-%m-%d_%H_%M_%S")
+            task_name = f"{self.dataset_name}_{self.dataset_sampling}"
+            if self.exp_name:
+                task_name = f"{self.exp_name}_{task_name}"
+            self.save_dir = os.path.join(
+                "session",
+                self.distributed_algorithm,
+                task_name,
+                self.model_name,
+                date,
+                str(uuid.uuid4()),
+            )
+        if not self.log_file:
+            self.log_file = os.path.join("log", self.save_dir.replace(os.sep, "_") + ".log")
+        set_level(self.log_level)
+
+    def create_practitioners(self):
+        """Partition the dataset over ``worker_number`` practitioners
+        (reference ``config.py:55-72``)."""
+        from .practitioner import create_practitioners
+
+        return create_practitioners(self)
+
+    def create_dataset_collection(self):
+        from .data import create_dataset_collection
+
+        return create_dataset_collection(self)
+
+    def replace(self, **kwargs) -> "DistributedTrainingConfig":
+        new = copy.deepcopy(self)
+        for k, v in kwargs.items():
+            setattr(new, k, v)
+        return new
+
+
+_FIELD_NAMES = {f.name for f in dataclasses.fields(DistributedTrainingConfig)}
+_DICT_FIELDS = {
+    f.name
+    for f in dataclasses.fields(DistributedTrainingConfig)
+    if f.default_factory is dict  # type: ignore[comparison-overlap]
+}
+
+
+def _coerce(value: str) -> Any:
+    """Parse a ``++key=value`` override string into a python value."""
+    try:
+        return yaml.safe_load(value)
+    except yaml.YAMLError:
+        return value
+
+
+def apply_overrides(config: DistributedTrainingConfig, overrides: dict[str, Any]) -> None:
+    for dotted, value in overrides.items():
+        parts = dotted.split(".")
+        if parts[0] not in _FIELD_NAMES:
+            raise KeyError(f"unknown config key: {dotted}")
+        if len(parts) == 1:
+            setattr(config, parts[0], value)
+        else:
+            node = getattr(config, parts[0])
+            if not isinstance(node, dict):
+                raise KeyError(f"cannot set nested key on non-dict field: {dotted}")
+            for part in parts[1:-1]:
+                node = node.setdefault(part, {})
+            node[parts[-1]] = value
+
+
+def _merge_conf_dict(config: DistributedTrainingConfig, conf: dict[str, Any]) -> None:
+    # single-key nesting unwrap (reference config.py:93-94)
+    while "dataset_name" not in conf and len(conf) == 1:
+        conf = next(iter(conf.values()))
+    for key, value in conf.items():
+        if key not in _FIELD_NAMES:
+            get_logger().warning("ignoring unknown config key %s", key)
+            continue
+        if key in _DICT_FIELDS and isinstance(value, dict):
+            merged = dict(getattr(config, key))
+            merged.update(value)
+            setattr(config, key, merged)
+        else:
+            setattr(config, key, value)
+
+
+def load_config_from_file(
+    config_file: str,
+    global_conf_path: str | None = None,
+    overrides: dict[str, Any] | None = None,
+) -> DistributedTrainingConfig:
+    """Load one YAML file merged over ``conf/global.yaml``
+    (reference ``load_config_from_file``, ``config.py:98-104``)."""
+    config = DistributedTrainingConfig()
+    if global_conf_path is None:
+        candidate = os.path.join(CONF_DIR, "global.yaml")
+        global_conf_path = candidate if os.path.isfile(candidate) else None
+    if global_conf_path:
+        with open(global_conf_path, encoding="utf8") as f:
+            global_conf = yaml.safe_load(f) or {}
+        _merge_conf_dict(config, global_conf)
+    with open(config_file, encoding="utf8") as f:
+        conf = yaml.safe_load(f) or {}
+    _merge_conf_dict(config, conf)
+    if overrides:
+        apply_overrides(config, overrides)
+    config.load_config_and_process()
+    return config
+
+
+def parse_cli_args(argv: list[str]) -> tuple[str, dict[str, Any]]:
+    """Parse ``--config-name <name> ++a.b=c ...`` hydra-style arguments
+    (reference CLI surface: ``test.sh:2``)."""
+    config_name = ""
+    overrides: dict[str, Any] = {}
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg == "--config-name":
+            config_name = argv[i + 1]
+            i += 2
+        elif arg.startswith("--config-name="):
+            config_name = arg.split("=", 1)[1]
+            i += 1
+        elif arg.startswith("++") or arg.startswith("+"):
+            body = arg.lstrip("+")
+            key, _, value = body.partition("=")
+            overrides[key] = _coerce(value)
+            i += 1
+        else:
+            raise ValueError(f"unrecognized argument: {arg}")
+    if not config_name:
+        raise ValueError("--config-name is required")
+    return config_name, overrides
+
+
+def load_config(argv: list[str], conf_dir: str | None = None) -> DistributedTrainingConfig:
+    """Full CLI loader (reference ``load_config``, ``config.py:91-95``)."""
+    config_name, overrides = parse_cli_args(argv)
+    conf_dir = conf_dir or CONF_DIR
+    path = os.path.join(conf_dir, config_name)
+    if not path.endswith(".yaml"):
+        path += ".yaml"
+    # strip the algorithm prefix from override keys (``++fed_avg.round=1`` form,
+    # reference test.sh:2); the prefix mirrors the conf subdirectory name
+    cleaned: dict[str, Any] = {}
+    for key, value in overrides.items():
+        parts = key.split(".")
+        if parts[0] not in _FIELD_NAMES and len(parts) > 1 and parts[1] in _FIELD_NAMES:
+            key = ".".join(parts[1:])
+        cleaned[key] = value
+    return load_config_from_file(path, overrides=cleaned)

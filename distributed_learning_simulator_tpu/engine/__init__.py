@@ -1,0 +1,5 @@
+from .engine import ComputeEngine
+from .executor import Inferencer, Trainer
+from .hyper_parameter import HyperParameter
+
+__all__ = ["ComputeEngine", "Trainer", "Inferencer", "HyperParameter"]

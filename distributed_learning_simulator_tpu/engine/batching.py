@@ -1,0 +1,67 @@
+"""Static-shape batching.
+
+XLA requires static shapes; datasets whose size is not a multiple of the
+batch size are padded with zero-weight samples (``mask``) instead of a ragged
+final batch.  A "batched epoch" is a stacked pytree with leading dims
+``[n_batches, batch_size, ...]`` fed to ``lax.scan``.
+"""
+
+import numpy as np
+
+from ..data.collection import ArrayDataset
+from ..ml_type import MachineLearningPhase as Phase
+
+
+def make_epoch_batches(
+    dataset: ArrayDataset,
+    batch_size: int,
+    rng: np.random.Generator | None = None,
+) -> dict:
+    """Return {"input": [n, B, ...], "target": [n, B], "mask": [n, B]}."""
+    n = len(dataset)
+    assert n > 0, "empty dataset"
+    order = np.arange(n)
+    if rng is not None:
+        order = rng.permutation(order)
+    n_batches = max(1, (n + batch_size - 1) // batch_size)
+    padded = n_batches * batch_size
+    pad = padded - n
+    order = np.concatenate([order, np.zeros(pad, dtype=order.dtype)])
+    mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
+    inputs = dataset.inputs[order].reshape(n_batches, batch_size, *dataset.inputs.shape[1:])
+    targets = dataset.targets[order].reshape(n_batches, batch_size)
+    return {
+        "input": inputs,
+        "target": targets,
+        "mask": mask.reshape(n_batches, batch_size),
+    }
+
+
+def make_graph_batch(dataset: ArrayDataset, phase_mask_key: str = "mask") -> dict:
+    """Graph datasets train full-batch: one 'batch' = the whole graph, with
+    the phase mask as sample weights (transductive node classification)."""
+    graph = dataset.inputs
+    mask = graph[phase_mask_key].astype(np.float32)
+    return {
+        "input": {k: v for k, v in graph.items() if k != phase_mask_key},
+        "target": dataset.targets,
+        "mask": mask,
+    }
+
+
+def fixed_size_partition(indices: np.ndarray, size: int) -> tuple[np.ndarray, np.ndarray]:
+    """Pad/truncate an index set to exactly ``size``, returning (indices, mask).
+
+    Used by the SPMD fast path to give every client slot identical shapes.
+    """
+    n = len(indices)
+    if n >= size:
+        return indices[:size], np.ones(size, np.float32)
+    pad = np.zeros(size - n, dtype=indices.dtype if n else np.int64)
+    if n:
+        pad = np.full(size - n, indices[0], dtype=indices.dtype)
+    mask = np.concatenate([np.ones(n, np.float32), np.zeros(size - n, np.float32)])
+    return np.concatenate([indices, pad]), mask
+
+
+__all__ = ["make_epoch_batches", "make_graph_batch", "fixed_size_partition", "Phase"]

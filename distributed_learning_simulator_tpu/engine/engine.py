@@ -1,0 +1,140 @@
+"""The compute engine: jitted train/eval programs.
+
+This is the L0 layer of SURVEY.md §1 rebuilt TPU-first: where the reference
+delegates to torch's eager batch loop (``Trainer.train()`` in
+``cyy_torch_toolbox``), here an **epoch is one XLA program** — ``lax.scan``
+over pre-batched, device-resident arrays, with the optimizer update fused in.
+No per-batch host round-trips; hooks that need per-batch host visibility fall
+back to a single-step program.
+
+One ``ComputeEngine`` is shared by all workers of a task (same model/hyper
+params ⇒ same compiled executables; compile once, run N clients).
+"""
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..models.registry import ModelContext
+from ..ops.pytree import Params
+from .hyper_parameter import HyperParameter
+
+
+class ComputeEngine:
+    def __init__(
+        self,
+        model_ctx: ModelContext,
+        hyper_parameter: HyperParameter,
+        total_steps: int,
+    ) -> None:
+        self.model_ctx = model_ctx
+        self.hyper_parameter = hyper_parameter
+        self.total_steps = max(1, total_steps)
+        self.optimizer = hyper_parameter.make_optimizer(self.total_steps)
+        self.schedule = hyper_parameter.make_schedule(self.total_steps)
+
+    # ---- pure functions (also used by the SPMD executor under vmap/shard_map)
+
+    def init_params(self, seed: int) -> Params:
+        return self.model_ctx.init(jax.random.PRNGKey(seed))
+
+    def init_opt_state(self, params: Params):
+        return self.optimizer.init(params)
+
+    def loss_and_grad(self, params: Params, batch: dict, rng):
+        return jax.value_and_grad(self.model_ctx.loss, has_aux=True)(
+            params, batch, train=True, rngs={"dropout": rng} if rng is not None else None
+        )
+
+    def train_step_fn(self, params, opt_state, batch, rng):
+        (loss, aux), grads = self.loss_and_grad(params, batch, rng)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = {
+            "loss": loss,
+            "correct": aux["correct"],
+            "count": aux["count"],
+        }
+        return params, opt_state, metrics, grads
+
+    def train_epoch_fn(self, params, opt_state, batches, rng):
+        """One epoch as a single scan; returns summed metrics."""
+
+        def body(carry, batch):
+            params, opt_state, rng = carry
+            rng, step_rng = jax.random.split(rng)
+            params, opt_state, metrics, _ = self.train_step_fn(
+                params, opt_state, batch, step_rng
+            )
+            return (params, opt_state, rng), metrics
+
+        (params, opt_state, _), metrics = jax.lax.scan(body, (params, opt_state, rng), batches)
+        summed = {
+            "loss_sum": jnp.sum(metrics["loss"] * metrics["count"]),
+            "correct": jnp.sum(metrics["correct"]),
+            "count": jnp.sum(metrics["count"]),
+        }
+        return params, opt_state, summed
+
+    def eval_fn(self, params, batches):
+        def body(carry, batch):
+            loss, aux = self.model_ctx.loss(params, batch, train=False)
+            carry = {
+                "loss_sum": carry["loss_sum"] + jnp.sum(aux["loss_sum"]),
+                "correct": carry["correct"] + aux["correct"],
+                "count": carry["count"] + aux["count"],
+            }
+            return carry, None
+
+        init = {
+            "loss_sum": jnp.float32(0),
+            "correct": jnp.float32(0),
+            "count": jnp.float32(0),
+        }
+        out, _ = jax.lax.scan(body, init, batches)
+        return out
+
+    def eval_single_fn(self, params, batch):
+        loss, aux = self.model_ctx.loss(params, batch, train=False)
+        return {
+            "loss_sum": jnp.sum(aux["loss_sum"]),
+            "correct": aux["correct"],
+            "count": aux["count"],
+        }
+
+    # ---- jitted entry points (cached per engine instance)
+
+    @functools.cached_property
+    def train_epoch(self):
+        # no donation: params/opt_state buffers are shared with host-side
+        # caches (ModelCache, best-model hooks) across rounds
+        return jax.jit(self.train_epoch_fn)
+
+    @functools.cached_property
+    def train_step(self):
+        def step(params, opt_state, batch, rng):
+            params, opt_state, metrics, _ = self.train_step_fn(params, opt_state, batch, rng)
+            return params, opt_state, metrics
+
+        return jax.jit(step)
+
+    @functools.cached_property
+    def evaluate(self):
+        return jax.jit(self.eval_fn)
+
+    @functools.cached_property
+    def evaluate_single(self):
+        return jax.jit(self.eval_single_fn)
+
+
+def summarize_metrics(summed: dict[str, Any]) -> dict[str, float]:
+    count = float(summed["count"])
+    count = max(count, 1.0)
+    return {
+        "loss": float(summed["loss_sum"]) / count,
+        "accuracy": float(summed["correct"]) / count,
+        "count": count,
+    }

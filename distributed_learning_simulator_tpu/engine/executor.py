@@ -1,0 +1,274 @@
+"""Trainer / Inferencer with hook points.
+
+TPU-native equivalent of the toolbox ``Trainer``/``Inferencer`` surface the
+reference imports everywhere (SURVEY.md §2.13): local training with hook
+points (``ExecutorHookPoint``), performance metrics, parameter load/dump.
+The hot loop is the jitted epoch scan in :class:`ComputeEngine`; hooks that
+need per-batch host visibility (OPTIMIZER_STEP / AFTER_BATCH, used by the
+reference's ``GradientWorker``/``GraphWorker``) automatically switch the
+epoch to a per-step program.
+"""
+
+import time
+from collections.abc import Callable
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..data.collection import DatasetCollection
+from ..ml_type import ExecutorHookPoint, MachineLearningPhase, StopExecutingException
+from ..models.registry import ModelContext
+from ..ops.pytree import Params
+from ..utils.logging import get_logger
+from .batching import make_epoch_batches, make_graph_batch
+from .engine import ComputeEngine, summarize_metrics
+from .hyper_parameter import HyperParameter
+
+_PER_STEP_POINTS = (
+    ExecutorHookPoint.BEFORE_BATCH,
+    ExecutorHookPoint.AFTER_BATCH,
+    ExecutorHookPoint.OPTIMIZER_STEP,
+)
+
+
+class PerformanceMetric:
+    def __init__(self) -> None:
+        self.epoch_metrics: dict[int, dict[str, float]] = {}
+
+    def record(self, epoch: int, metrics: dict[str, float]) -> None:
+        self.epoch_metrics[epoch] = metrics
+
+    def get_epoch_metric(self, epoch: int, name: str) -> float | None:
+        return self.epoch_metrics.get(epoch, {}).get(name)
+
+    @property
+    def last(self) -> dict[str, float]:
+        if not self.epoch_metrics:
+            return {}
+        return self.epoch_metrics[max(self.epoch_metrics)]
+
+
+class ExecutorBase:
+    """Shared machinery for Trainer and Inferencer."""
+
+    def __init__(
+        self,
+        config,
+        dataset_collection: DatasetCollection,
+        model_ctx: ModelContext,
+        engine: ComputeEngine,
+        phase: MachineLearningPhase,
+        seed: int = 0,
+        name: str = "",
+    ) -> None:
+        self.config = config
+        self.dataset_collection = dataset_collection
+        self.model_ctx = model_ctx
+        self.engine = engine
+        self.phase = phase
+        self.name = name
+        self._seed = seed
+        self._params: Params | None = None
+        self.performance_metric = PerformanceMetric()
+        self.visualizer_prefix = ""
+
+    @property
+    def hyper_parameter(self) -> HyperParameter:
+        return self.engine.hyper_parameter
+
+    # --- parameter surface (reference ModelUtil/Trainer surface) ---
+    @property
+    def params(self) -> Params:
+        if self._params is None:
+            self._params = self.engine.init_params(self._seed)
+        return self._params
+
+    def get_parameter_dict(self) -> Params:
+        return dict(self.params)
+
+    def load_parameter_dict(self, params: Params) -> None:
+        self._params = dict(params)
+
+    @property
+    def dataset_size(self) -> int:
+        return self.dataset_collection.dataset_size(self.phase)
+
+    def set_visualizer_prefix(self, prefix: str) -> None:
+        self.visualizer_prefix = prefix
+
+    # device management is a no-op under single-controller JAX (the reference
+    # needed a cross-process device lock, executor.py:41-96)
+    def set_device(self, *args, **kwargs) -> None:
+        pass
+
+    def offload_from_device(self) -> None:
+        pass
+
+    def wait_stream(self) -> None:
+        jax.block_until_ready(jax.tree.leaves(self.params))
+
+    def _epoch_batches(self, phase: MachineLearningPhase, shuffle_seed: int | None):
+        dataset = self.dataset_collection.get_dataset(phase)
+        if self.dataset_collection.dataset_type == "graph" or isinstance(
+            dataset.inputs, dict
+        ):
+            batch = make_graph_batch(dataset)
+            return jax.tree.map(lambda x: np.asarray(x)[None], batch)  # 1-batch epoch
+        rng = None if shuffle_seed is None else np.random.default_rng(shuffle_seed)
+        return make_epoch_batches(dataset, self.hyper_parameter.batch_size, rng)
+
+
+class Trainer(ExecutorBase):
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, phase=MachineLearningPhase.Training, **kwargs)
+        self._hooks: dict[ExecutorHookPoint, dict[str, Callable]] = {}
+        self._disabled_hooks: set[str] = set()
+        self._opt_state = None
+        self._rng = jax.random.PRNGKey(self._seed + 0x5EED)
+        self._epoch_counter = 0  # cumulative epochs across rounds
+        self.batch_loss_log_enabled = True
+
+    # --- hook API (reference Trainer.append_named_hook/remove_hook/...) ---
+    def append_named_hook(
+        self, hook_point: ExecutorHookPoint, name: str, fn: Callable
+    ) -> None:
+        self._hooks.setdefault(hook_point, {})[name] = fn
+
+    def remove_named_hook(self, name: str, hook_point: ExecutorHookPoint | None = None) -> None:
+        points = [hook_point] if hook_point else list(self._hooks)
+        for point in points:
+            self._hooks.get(point, {}).pop(name, None)
+
+    def has_hook(self, hook_point: ExecutorHookPoint) -> bool:
+        return any(
+            name not in self._disabled_hooks
+            for name in self._hooks.get(hook_point, {})
+        )
+
+    def disable_hook(self, name: str) -> None:
+        self._disabled_hooks.add(name)
+
+    def enable_hook(self, name: str) -> None:
+        self._disabled_hooks.discard(name)
+
+    def _fire(self, hook_point: ExecutorHookPoint, **kwargs) -> None:
+        for name, fn in list(self._hooks.get(hook_point, {}).items()):
+            if name in self._disabled_hooks:
+                continue
+            fn(executor=self, hook_point=hook_point, **kwargs)
+
+    # --- optimizer state ---
+    @property
+    def opt_state(self):
+        if self._opt_state is None:
+            self._opt_state = self.engine.init_opt_state(self.params)
+        return self._opt_state
+
+    def reset_optimizer(self) -> None:
+        self._opt_state = None
+
+    def load_parameter_dict(self, params: Params, reuse_learning_rate: bool = False) -> None:
+        """Reference ``load_parameters`` (``util/model.py:6-23``): loading new
+        global params rebuilds the optimizer unless lr state is reused
+        (FedOBD phase 2)."""
+        super().load_parameter_dict(params)
+        if not reuse_learning_rate:
+            self._opt_state = None
+
+    # --- the round-local training loop ---
+    def train(self, **kwargs) -> None:
+        hp = self.hyper_parameter
+        self._fire(ExecutorHookPoint.BEFORE_EXECUTE)
+        per_step = any(self.has_hook(p) for p in _PER_STEP_POINTS)
+        try:
+            for epoch in range(1, hp.epoch + 1):
+                start = time.monotonic()
+                self._epoch_counter += 1
+                shuffle_seed = self._seed * 100003 + self._epoch_counter
+                batches = self._epoch_batches(self.phase, shuffle_seed)
+                self._fire(ExecutorHookPoint.BEFORE_EPOCH, epoch=epoch)
+                self._rng, epoch_rng = jax.random.split(self._rng)
+                if per_step:
+                    summed = self._train_epoch_per_step(batches, epoch, epoch_rng)
+                else:
+                    params, opt_state, summed = self.engine.train_epoch(
+                        self.params, self.opt_state, batches, epoch_rng
+                    )
+                    self._params, self._opt_state = params, opt_state
+                metrics = summarize_metrics(summed)
+                metrics["duration"] = time.monotonic() - start
+                self.performance_metric.record(self._epoch_counter, metrics)
+                if self.batch_loss_log_enabled or self.config is None or self.config.debug:
+                    get_logger().info(
+                        "%s epoch %d loss %.4f acc %.4f (%.2fs)",
+                        self.visualizer_prefix or self.name,
+                        epoch,
+                        metrics["loss"],
+                        metrics["accuracy"],
+                        metrics["duration"],
+                    )
+                self._fire(
+                    ExecutorHookPoint.AFTER_EPOCH, epoch=epoch, epoch_metrics=metrics
+                )
+            self._fire(ExecutorHookPoint.AFTER_EXECUTE)
+        except StopExecutingException:
+            get_logger().debug("%s stopped by hook", self.name)
+
+    def _train_epoch_per_step(self, batches, epoch: int, epoch_rng) -> dict:
+        n_batches = batches["target"].shape[0]
+        totals = {"loss_sum": 0.0, "correct": 0.0, "count": 0.0}
+        step_rngs = jax.random.split(epoch_rng, n_batches)
+        for i in range(n_batches):
+            batch = jax.tree.map(lambda x: x[i], batches)
+            self._fire(
+                ExecutorHookPoint.BEFORE_BATCH, epoch=epoch, batch_index=i, batch=batch
+            )
+            if self.has_hook(ExecutorHookPoint.OPTIMIZER_STEP):
+                # the hook owns the optimizer step (reference GradientWorker
+                # semantics, gradient_worker.py:50-116)
+                self._fire(
+                    ExecutorHookPoint.OPTIMIZER_STEP,
+                    epoch=epoch,
+                    batch_index=i,
+                    batch=batch,
+                    step_rng=step_rngs[i],
+                )
+                result = self.engine.evaluate_single(self.params, batch)
+                summed = {
+                    "loss_sum": result["loss_sum"],
+                    "correct": result["correct"],
+                    "count": result["count"],
+                }
+            else:
+                params, opt_state, metrics = self.engine.train_step(
+                    self.params, self.opt_state, batch, step_rngs[i]
+                )
+                self._params, self._opt_state = params, opt_state
+                summed = {
+                    "loss_sum": metrics["loss"] * metrics["count"],
+                    "correct": metrics["correct"],
+                    "count": metrics["count"],
+                }
+            for key in totals:
+                totals[key] += float(summed[key])
+            self._fire(
+                ExecutorHookPoint.AFTER_BATCH,
+                epoch=epoch,
+                batch_index=i,
+                batch=batch,
+                batch_size=float(summed["count"]),
+            )
+        return totals
+
+
+class Inferencer(ExecutorBase):
+    def __init__(self, *args, phase=MachineLearningPhase.Test, **kwargs) -> None:
+        super().__init__(*args, phase=phase, **kwargs)
+
+    def inference(self) -> dict[str, float]:
+        batches = self._epoch_batches(self.phase, shuffle_seed=None)
+        summed = self.engine.evaluate(self.params, batches)
+        metrics = summarize_metrics(summed)
+        self.performance_metric.record(len(self.performance_metric.epoch_metrics) + 1, metrics)
+        return metrics

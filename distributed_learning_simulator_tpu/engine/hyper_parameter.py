@@ -1,0 +1,72 @@
+"""Hyper-parameters and optimizer construction.
+
+TPU-native equivalent of the toolbox hyper-parameter surface the reference
+reads from YAML (``optimizer_name``, ``learning_rate``,
+``learning_rate_scheduler_name``, ``momentum``, ``weight_decay`` — SURVEY.md
+§2.2).  Optimizers are optax transforms; ``CosineAnnealingLR`` is a per-step
+cosine schedule over the local run, matching torch's per-epoch cosine in the
+limit.
+"""
+
+import dataclasses
+from typing import Any
+
+import optax
+
+
+@dataclasses.dataclass
+class HyperParameter:
+    epoch: int = 1
+    batch_size: int = 64
+    learning_rate: float = 0.01
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    optimizer_name: str = "SGD"
+    learning_rate_scheduler_name: str = "CosineAnnealingLR"
+    extra: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_config(cls, config) -> "HyperParameter":
+        return cls(
+            epoch=config.epoch,
+            batch_size=config.batch_size,
+            learning_rate=config.learning_rate,
+            momentum=getattr(config, "momentum", 0.9),
+            weight_decay=config.weight_decay,
+            optimizer_name=config.optimizer_name,
+            learning_rate_scheduler_name=config.learning_rate_scheduler_name,
+            extra=dict(config.extra_hyper_parameters),
+        )
+
+    def make_schedule(self, total_steps: int):
+        total_steps = max(1, total_steps)
+        name = (self.learning_rate_scheduler_name or "").lower()
+        if name in ("cosineannealinglr", "cosine"):
+            return optax.cosine_decay_schedule(self.learning_rate, decay_steps=total_steps)
+        if name in ("", "none", "constant", "constantlr"):
+            return optax.constant_schedule(self.learning_rate)
+        if name in ("linearlr", "linear"):
+            return optax.linear_schedule(self.learning_rate, 0.0, total_steps)
+        raise KeyError(f"unknown lr scheduler {self.learning_rate_scheduler_name!r}")
+
+    def make_optimizer(self, total_steps: int) -> optax.GradientTransformation:
+        schedule = self.make_schedule(total_steps)
+        name = self.optimizer_name.lower()
+        parts = []
+        if self.weight_decay:
+            parts.append(optax.add_decayed_weights(self.weight_decay))
+        if name == "sgd":
+            if self.momentum:
+                parts.append(optax.trace(decay=self.momentum, nesterov=False))
+            parts.append(optax.scale_by_learning_rate(schedule))
+        elif name == "adam":
+            parts = [optax.scale_by_adam(), *parts, optax.scale_by_learning_rate(schedule)]
+        elif name == "adamw":
+            parts = [
+                optax.scale_by_adam(),
+                optax.add_decayed_weights(self.weight_decay),
+                optax.scale_by_learning_rate(schedule),
+            ]
+        else:
+            raise KeyError(f"unknown optimizer {self.optimizer_name!r}")
+        return optax.chain(*parts)

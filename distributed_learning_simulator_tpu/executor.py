@@ -1,0 +1,58 @@
+"""Executor base for workers and the server.
+
+TPU-native equivalent of ``simulation_lib/executor.py:16-96``.  The reference
+needed a gevent semaphore per process plus a cross-process device lock to
+time-share CUDA devices between greenlets; under single-controller JAX there
+is one process and XLA serializes device work, so the execution context is
+reduced to thread naming for log attribution and the save-dir convention.
+"""
+
+import copy
+import os
+import threading
+
+from .config import DistributedTrainingConfig
+
+
+class ExecutorContext:
+    """Names the current thread for log attribution (reference
+    ``ExecutorContext``, ``executor.py:16-38``; the semaphore is gone by
+    design)."""
+
+    def __init__(self, name: str) -> None:
+        self._name = name
+
+    def __enter__(self) -> "ExecutorContext":
+        threading.current_thread().name = self._name
+        return self
+
+    def __exit__(self, *exc) -> None:
+        threading.current_thread().name = "dls-idle"
+
+
+class Executor:
+    def __init__(
+        self,
+        config: DistributedTrainingConfig,
+        name: str,
+        task_context,
+    ) -> None:
+        self.config: DistributedTrainingConfig = copy.copy(config)
+        self._name = name
+        self._task_context = task_context
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def save_dir(self) -> str:
+        save_dir = os.path.join(self.config.save_dir, self._name.replace(" ", "_"))
+        os.makedirs(save_dir, exist_ok=True)
+        return save_dir
+
+    def _get_execution_context(self) -> ExecutorContext:
+        return ExecutorContext(self._name)
+
+    def start(self) -> None:
+        raise NotImplementedError

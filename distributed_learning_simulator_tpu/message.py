@@ -1,0 +1,95 @@
+"""Message protocol.
+
+TPU-native equivalent of ``simulation_lib/message.py:10-62``.  Messages carry
+host-side control metadata; parameter payloads are flat dicts of (device
+resident) jax arrays — they are handed over by reference inside one process,
+never serialized through pipes like the reference's pickled tensor dicts.
+"""
+
+import dataclasses
+import os
+from typing import Any
+
+import numpy as np
+
+from .ops.pytree import Params, param_nbytes
+
+
+@dataclasses.dataclass(kw_only=True)
+class Message:
+    other_data: dict[str, Any] = dataclasses.field(default_factory=dict)
+    in_round: bool = False  # doesn't advance the round counter
+    end_training: bool = False
+
+
+@dataclasses.dataclass(kw_only=True)
+class ParameterMessageBase(Message):
+    is_initial: bool = False
+
+
+@dataclasses.dataclass(kw_only=True)
+class ParameterMessage(ParameterMessageBase):
+    parameter: Params
+    dataset_size: int = 0
+
+    def complete(self, old_parameter: Params) -> "ParameterMessage":
+        """Fill missing keys from the old global params (partial uploads from
+        FedOBD block dropout — reference ``message.py:26-29``)."""
+        for key, value in old_parameter.items():
+            if key not in self.parameter:
+                self.parameter[key] = value
+        return self
+
+
+@dataclasses.dataclass(kw_only=True)
+class DeltaParameterMessage(ParameterMessageBase):
+    delta_parameter: Params
+    dataset_size: int = 0
+
+    def restore(self, old_parameter: Params) -> ParameterMessage:
+        """Add deltas onto the old params (reference ``message.py:37-49``)."""
+        parameter = {
+            k: old_parameter[k] + self.delta_parameter[k] for k in self.delta_parameter
+        }
+        for key, value in old_parameter.items():
+            parameter.setdefault(key, value)
+        return ParameterMessage(
+            parameter=parameter,
+            dataset_size=self.dataset_size,
+            other_data=self.other_data,
+            in_round=self.in_round,
+            end_training=self.end_training,
+        )
+
+
+@dataclasses.dataclass(kw_only=True)
+class ParameterFileMessage(ParameterMessageBase):
+    """Path-only variant (declared in the reference, ``message.py:32-34``)."""
+
+    path: str
+    dataset_size: int = 0
+
+    def load(self) -> ParameterMessage:
+        blob = np.load(self.path)
+        return ParameterMessage(
+            parameter={k: blob[k] for k in blob.files},
+            dataset_size=self.dataset_size,
+            other_data=self.other_data,
+        )
+
+    @staticmethod
+    def dump(parameter: Params, path: str, **kwargs) -> "ParameterFileMessage":
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        np.savez(path, **{k: np.asarray(v) for k, v in parameter.items()})
+        return ParameterFileMessage(path=path, **kwargs)
+
+
+def get_message_size(message: Message) -> int:
+    """Payload bytes of a message (reference ``get_message_size``,
+    ``message.py:52-62``)."""
+    total = 0
+    for field in dataclasses.fields(message):
+        value = getattr(message, field.name)
+        if isinstance(value, dict):
+            total += param_nbytes(value)
+    return total

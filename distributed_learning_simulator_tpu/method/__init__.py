@@ -1,0 +1,16 @@
+"""Method packages: importing this module registers every algorithm
+(reference ``simulation_lib/method/__init__.py:1-9`` — registrations fire at
+import time)."""
+
+from .algorithm_factory import CentralizedAlgorithmFactory
+
+from . import fed_avg  # noqa: F401
+from . import fed_paq  # noqa: F401
+from . import fed_dropout_avg  # noqa: F401
+from . import fed_obd  # noqa: F401
+from . import sign_sgd  # noqa: F401
+from . import smafd  # noqa: F401
+from . import shapley_value  # noqa: F401
+from . import fed_gnn  # noqa: F401
+
+__all__ = ["CentralizedAlgorithmFactory"]

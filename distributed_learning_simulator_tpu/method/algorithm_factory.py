@@ -1,0 +1,87 @@
+"""The algorithm registry.
+
+TPU-native equivalent of ``simulation_lib/method/algorithm_factory.py:6-79``:
+``register_algorithm(name, client_cls, server_cls, client_endpoint_cls,
+server_endpoint_cls, algorithm_cls)`` plus ``create_client``/``create_server``
+that construct the endpoint and then the role, auto-instantiating the
+aggregation algorithm into the server kwargs.
+"""
+
+import dataclasses
+from typing import Any
+
+from ..topology.central_topology import CentralTopology, ClientEndpoint, ServerEndpoint
+
+
+@dataclasses.dataclass
+class _Registration:
+    algorithm_name: str
+    client_cls: type
+    server_cls: type
+    client_endpoint_cls: type
+    server_endpoint_cls: type
+    algorithm_cls: type | None
+    # TPU build: optional SPMD round program for the fast path (parallel/)
+    spmd_program_cls: type | None = None
+
+
+class CentralizedAlgorithmFactory:
+    config: dict[str, _Registration] = {}
+
+    @classmethod
+    def register_algorithm(
+        cls,
+        algorithm_name: str,
+        client_cls: type,
+        server_cls: type,
+        client_endpoint_cls: type = ClientEndpoint,
+        server_endpoint_cls: type = ServerEndpoint,
+        algorithm_cls: type | None = None,
+        spmd_program_cls: type | None = None,
+    ) -> None:
+        assert algorithm_name not in cls.config, f"duplicate algorithm {algorithm_name}"
+        cls.config[algorithm_name] = _Registration(
+            algorithm_name=algorithm_name,
+            client_cls=client_cls,
+            server_cls=server_cls,
+            client_endpoint_cls=client_endpoint_cls,
+            server_endpoint_cls=server_endpoint_cls,
+            algorithm_cls=algorithm_cls,
+        )
+        cls.config[algorithm_name].spmd_program_cls = spmd_program_cls
+
+    @classmethod
+    def has_algorithm(cls, algorithm_name: str) -> bool:
+        return algorithm_name in cls.config
+
+    @classmethod
+    def get_registration(cls, algorithm_name: str) -> _Registration:
+        return cls.config[algorithm_name]
+
+    @classmethod
+    def create_client(
+        cls,
+        algorithm_name: str,
+        topology: CentralTopology,
+        worker_id: int,
+        endpoint_kwargs: dict | None = None,
+        kwargs: dict | None = None,
+    ) -> Any:
+        reg = cls.config[algorithm_name]
+        endpoint = reg.client_endpoint_cls(topology, worker_id, **(endpoint_kwargs or {}))
+        return reg.client_cls(endpoint=endpoint, **(kwargs or {}))
+
+    @classmethod
+    def create_server(
+        cls,
+        algorithm_name: str,
+        topology: CentralTopology,
+        endpoint_kwargs: dict | None = None,
+        kwargs: dict | None = None,
+    ) -> Any:
+        reg = cls.config[algorithm_name]
+        endpoint = reg.server_endpoint_cls(topology, **(endpoint_kwargs or {}))
+        kwargs = dict(kwargs or {})
+        if reg.algorithm_cls is not None and "algorithm" not in kwargs:
+            kwargs["algorithm"] = reg.algorithm_cls()
+        return reg.server_cls(endpoint=endpoint, **kwargs)

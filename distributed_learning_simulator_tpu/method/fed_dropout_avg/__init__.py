@@ -1,0 +1,15 @@
+"""FedDropoutAvg (arXiv 2111.13230): per-element Bernoulli dropout of the
+full uploaded parameters; aggregation weight = nonzero mask × dataset size
+(reference ``simulation_lib/method/fed_dropout_avg/__init__.py:7-12``)."""
+
+from ...server.aggregation_server import AggregationServer
+from ..algorithm_factory import CentralizedAlgorithmFactory
+from .algorithm import FedDropoutAvgAlgorithm
+from .worker import FedDropoutAvgWorker
+
+CentralizedAlgorithmFactory.register_algorithm(
+    algorithm_name="fed_dropout_avg",
+    client_cls=FedDropoutAvgWorker,
+    server_cls=AggregationServer,
+    algorithm_cls=FedDropoutAvgAlgorithm,
+)

@@ -1,0 +1,18 @@
+"""FedDropoutAvg aggregation (reference
+``simulation_lib/method/fed_dropout_avg/algorithm.py:8-19``): per-element
+weights = (parameter != 0) × dataset_size, with a divide-by-zero guard."""
+
+import jax.numpy as jnp
+
+from ...algorithm.fed_avg_algorithm import FedAVGAlgorithm
+
+
+class FedDropoutAvgAlgorithm(FedAVGAlgorithm):
+    def _get_weight(self, dataset_size: int, name: str, parameter):
+        return (parameter != 0).astype(jnp.float32) * dataset_size
+
+    def _apply_total_weight(self, name: str, parameter, total_weight):
+        total_weight = jnp.where(total_weight == 0, 1.0, total_weight)
+        return super()._apply_total_weight(
+            name=name, parameter=parameter, total_weight=total_weight
+        )

@@ -1,0 +1,44 @@
+"""FedDropoutAvg client (reference
+``simulation_lib/method/fed_dropout_avg/worker.py:10-30``): before upload,
+each parameter element is zeroed with probability ``dropout_rate``; the send
+count is logged for the communication cost model
+(``analysis/analyze_log.py``)."""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ...message import ParameterMessage
+from ...utils.logging import get_logger
+from ...worker.aggregation_worker import AggregationWorker
+
+
+class FedDropoutAvgWorker(AggregationWorker):
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._dropout_rate: float = self.config.algorithm_kwargs["dropout_rate"]
+        self._drop_round = 0
+
+    def _get_sent_data(self) -> ParameterMessage:
+        self._send_parameter_diff = False
+        sent_data = super()._get_sent_data()
+        assert isinstance(sent_data, ParameterMessage)
+        self._drop_round += 1
+        key = jax.random.PRNGKey(
+            self.config.seed * 1_000_003 + self.worker_id * 1009 + self._drop_round
+        )
+        parameter = sent_data.parameter
+        total_num = 0
+        send_num = 0
+        for name in sorted(parameter):
+            key, sub = jax.random.split(key)
+            keep = jax.random.bernoulli(
+                sub, p=1.0 - self._dropout_rate, shape=parameter[name].shape
+            )
+            parameter[name] = parameter[name] * keep
+            total_num += int(parameter[name].size)
+            send_num += int(jnp.count_nonzero(parameter[name]))
+        get_logger().info("send_num %s", send_num)
+        get_logger().info("total_num %s", total_num)
+        return sent_data

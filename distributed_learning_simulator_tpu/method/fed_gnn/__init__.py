@@ -1,0 +1,28 @@
+"""Federated GNN with boundary-embedding sharing
+(reference ``simulation_lib/method/fed_gnn/__init__.py:4-8``)."""
+
+from ...server.graph_server import GraphNodeServer
+from ...worker.graph_worker import GraphWorker
+from ..algorithm_factory import CentralizedAlgorithmFactory
+
+
+class FedGCNWorker(GraphWorker):
+    """FedGCN paper variant: feature sharing forced on (reference
+    ``simulation_lib/method/fed_gcn/worker.py:4-7``)."""
+
+    def __init__(self, **kwargs) -> None:
+        super().__init__(**kwargs)
+        self._share_feature = True
+
+
+CentralizedAlgorithmFactory.register_algorithm(
+    algorithm_name="fed_gnn",
+    client_cls=GraphWorker,
+    server_cls=GraphNodeServer,
+)
+
+CentralizedAlgorithmFactory.register_algorithm(
+    algorithm_name="fed_gcn",
+    client_cls=FedGCNWorker,
+    server_cls=GraphNodeServer,
+)

@@ -1,0 +1,28 @@
+"""FedOBD: two-phase opportunistic block dropout over quantized transport
+(reference ``simulation_lib/method/fed_obd/__init__.py:8-22``)."""
+
+from ...topology.quantized_endpoint import (
+    NNADQClientEndpoint,
+    NNADQServerEndpoint,
+    StochasticQuantClientEndpoint,
+    StochasticQuantServerEndpoint,
+)
+from ..algorithm_factory import CentralizedAlgorithmFactory
+from .server import FedOBDServer
+from .worker import FedOBDWorker
+
+CentralizedAlgorithmFactory.register_algorithm(
+    algorithm_name="fed_obd",
+    client_cls=FedOBDWorker,
+    server_cls=FedOBDServer,
+    client_endpoint_cls=NNADQClientEndpoint,
+    server_endpoint_cls=NNADQServerEndpoint,
+)
+
+CentralizedAlgorithmFactory.register_algorithm(
+    algorithm_name="fed_obd_sq",
+    client_cls=FedOBDWorker,
+    server_cls=FedOBDServer,
+    client_endpoint_cls=StochasticQuantClientEndpoint,
+    server_endpoint_cls=StochasticQuantServerEndpoint,
+)

@@ -1,0 +1,97 @@
+"""Opportunistic Block Dropout.
+
+TPU-native equivalent of
+``simulation_lib/method/fed_obd/obd_algorithm.py:8-145``: decompose the model
+into blocks, rank blocks by mean L2 delta against the cached global model,
+and greedily keep blocks under the ``1 - dropout_rate`` parameter budget.
+
+Blocks here are groups of flat parameter paths sharing a top-level module
+prefix (flax module instances — e.g. one ``DenseLayer_k`` of densenet40, one
+``EncoderLayer_k`` of the transformer), the structural analogue of the
+reference's (Conv,BN) groups / TransformerEncoderLayer blocks.  The block
+L2 deltas are computed in one fused jit program instead of per-block CPU
+norms.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.pytree import Params
+from ...utils.logging import get_logger
+
+
+def get_module_blocks(parameter_names: list[str]) -> list[list[str]]:
+    """Group flat "a/b/kernel" names by their leading module component."""
+    blocks: dict[str, list[str]] = {}
+    for name in sorted(parameter_names):
+        prefix = name.split("/")[0] if "/" in name else name
+        blocks.setdefault(prefix, []).append(name)
+    return list(blocks.values())
+
+
+@jax.jit
+def _block_deltas(cur: Params, prev: Params) -> Params:
+    return {
+        k: jnp.sum(jnp.square(cur[k].astype(jnp.float32) - prev[k].astype(jnp.float32)))
+        for k in cur
+    }
+
+
+class OpportunisticBlockDropoutAlgorithm:
+    def __init__(self, dropout_rate: float, worker_id: int) -> None:
+        self.__dropout_rate = dropout_rate
+        self.__worker_id = worker_id
+        self.__blocks: list[list[str]] | None = None
+        self.__parameter_num = 0
+
+    def __find_blocks(self, parameter_dict: Params) -> None:
+        self.__blocks = get_module_blocks(list(parameter_dict.keys()))
+        covered = {name for block in self.__blocks for name in block}
+        assert covered == set(parameter_dict.keys())
+        self.__parameter_num = sum(int(v.size) for v in parameter_dict.values())
+        if self.__worker_id == 0:
+            get_logger().info(
+                "identified %d blocks over %d parameters",
+                len(self.__blocks),
+                self.__parameter_num,
+            )
+
+    def get_block_parameter(self, parameter_dict: Params, model_cache) -> Params:
+        """Return the selected blocks' parameters (full values; the server
+        completes missing keys from the old global model).
+
+        Deviation from the reference: its phase-1 upload stores block *diffs*
+        in ``ParameterMessage.parameter`` which the server then completes
+        with full old values and averages — mixing deltas with parameters
+        (``method/fed_obd/worker.py:59-69``); here the payload is the blocks'
+        parameters, the coherent FedOBD-paper semantics.
+        """
+        if self.__blocks is None:
+            self.__find_blocks(parameter_dict)
+        assert self.__blocks is not None
+        threshold = (1 - self.__dropout_rate) * self.__parameter_num
+
+        per_name_sq = _block_deltas(parameter_dict, model_cache.parameter_dict)
+        scored: list[tuple[float, int, list[str]]] = []
+        for block in self.__blocks:
+            sq = sum(float(per_name_sq[name]) for name in block)
+            size = sum(int(parameter_dict[name].size) for name in block)
+            scored.append((float(jnp.sqrt(sq)) / size, size, block))
+
+        new_parameter_dict: Params = {}
+        partial_parameter_num = 0
+        for mean_delta, size, block in sorted(scored, key=lambda t: t[0], reverse=True):
+            if partial_parameter_num > threshold:
+                break
+            if partial_parameter_num + size > threshold:
+                continue
+            partial_parameter_num += size
+            for name in block:
+                new_parameter_dict[name] = parameter_dict[name]
+        get_logger().info(
+            "partial_parameter_num %s threshold %s parameter_num %s",
+            partial_parameter_num,
+            threshold,
+            self.__parameter_num,
+        )
+        return new_parameter_dict

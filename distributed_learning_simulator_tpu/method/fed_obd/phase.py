@@ -1,0 +1,9 @@
+"""FedOBD phases (reference ``simulation_lib/method/fed_obd/phase.py:4-7``)."""
+
+from enum import IntEnum, auto
+
+
+class Phase(IntEnum):
+    STAGE_ONE = auto()
+    STAGE_TWO = auto()
+    END = auto()

@@ -1,0 +1,68 @@
+"""FedOBD server (reference ``simulation_lib/method/fed_obd/server.py:10-61``):
+phase state machine over the FedAvg aggregator — phase 1 rounds with random
+selection and quantized broadcast; switch to phase 2 when rounds are
+exhausted (or converged under early-stop); end on phase-2 plateau or worker
+``end_training``."""
+
+from typing import Any
+
+from ...algorithm.fed_avg_algorithm import FedAVGAlgorithm
+from ...message import ParameterMessageBase
+from ...server.aggregation_server import AggregationServer
+from ...topology.quantized_endpoint import QuantServerEndpoint
+from ...utils.logging import get_logger
+from .phase import Phase
+
+
+class FedOBDServer(AggregationServer):
+    def __init__(self, **kwargs: Any) -> None:
+        kwargs.setdefault("algorithm", FedAVGAlgorithm())
+        super().__init__(**kwargs)
+        self.__phase: Phase = Phase.STAGE_ONE
+        assert isinstance(self._endpoint, QuantServerEndpoint)
+        self._endpoint.quant_broadcast = True
+
+    def _select_workers(self) -> set[int]:
+        if self.__phase != Phase.STAGE_ONE:
+            return set(range(self.worker_number))
+        return super()._select_workers()
+
+    def _get_stat_key(self) -> int:
+        if not self.performance_stat:
+            return super()._get_stat_key()
+        return max(self.performance_stat.keys()) + 1
+
+    def _aggregate_worker_data(self) -> ParameterMessageBase:
+        result = super()._aggregate_worker_data()
+        assert result is not None
+        self._compute_stat = False
+        if self.__phase == Phase.STAGE_ONE:
+            self._compute_stat = True
+        if "check_acc" in result.other_data:
+            self._compute_stat = True
+        if result.end_training:
+            self.__phase = Phase.END
+        match self.__phase:
+            case Phase.STAGE_ONE:
+                if self.round_number >= self.config.round or (
+                    self.early_stop and not self.__has_improvement()
+                ):
+                    get_logger().info("switch to phase 2")
+                    self.__phase = Phase.STAGE_TWO
+                    result.other_data["phase_two"] = True
+            case Phase.STAGE_TWO:
+                if self.early_stop and not self.__has_improvement():
+                    get_logger().info("stop aggregation")
+                    result.end_training = True
+            case Phase.END:
+                pass
+        return result
+
+    def _stopped(self) -> bool:
+        return self.__phase == Phase.END
+
+    def __has_improvement(self) -> bool:
+        # the reference short-circuits phase 2 to "always improving"
+        # (method/fed_obd/server.py:57-60), making its documented phase-2
+        # plateau stop dead code; here phase 2 also uses the plateau test
+        return not self._convergent()

@@ -1,0 +1,68 @@
+"""FedOBD client (reference ``simulation_lib/method/fed_obd/worker.py:12-74``):
+phase 1 uploads block-dropout'd partial parameters through a quantized
+endpoint; on the server's ``phase_two`` signal switches to per-epoch
+``in_round`` aggregation with lr reuse for ``second_phase_epoch`` epochs."""
+
+from typing import Any
+
+from ...message import Message, ParameterMessage
+from ...ml_type import ExecutorHookPoint
+from ...topology.quantized_endpoint import QuantClientEndpoint
+from ...utils.logging import get_logger
+from ...worker.aggregation_worker import AggregationWorker
+from .obd_algorithm import OpportunisticBlockDropoutAlgorithm
+from .phase import Phase
+
+
+class FedOBDWorker(AggregationWorker, OpportunisticBlockDropoutAlgorithm):
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        AggregationWorker.__init__(self, *args, **kwargs)
+        OpportunisticBlockDropoutAlgorithm.__init__(
+            self,
+            dropout_rate=self.config.algorithm_kwargs["dropout_rate"],
+            worker_id=self.worker_id,
+        )
+        self.__phase = Phase.STAGE_ONE
+        self.__end_training = False
+        assert isinstance(self._endpoint, QuantClientEndpoint)
+        self._endpoint.dequant_server_data = True
+        self._send_parameter_diff = False
+
+    def _load_result_from_server(self, result: Message) -> None:
+        if "phase_two" in result.other_data:
+            assert isinstance(result, ParameterMessage)
+            self.__phase = Phase.STAGE_TWO
+            get_logger().info("%s switches to phase 2", self.name)
+            self._reuse_learning_rate = True
+            self._send_parameter_diff = True
+            self.disable_choose_model_by_validation()
+            self.trainer.hyper_parameter.epoch = self.config.algorithm_kwargs[
+                "second_phase_epoch"
+            ]
+            self.config.round = self._round_num + 1
+            self._aggregation_time = ExecutorHookPoint.AFTER_EPOCH
+            self._register_aggregation()
+        super()._load_result_from_server(result=result)
+
+    def _aggregation(self, sent_data: Message, **kwargs: Any) -> None:
+        if self.__phase == Phase.STAGE_TWO:
+            executor = kwargs["executor"]
+            if kwargs["epoch"] == executor.hyper_parameter.epoch:
+                sent_data.end_training = True
+                self.__end_training = True
+        super()._aggregation(sent_data=sent_data, **kwargs)
+
+    def _stopped(self) -> bool:
+        return self.__end_training or super()._stopped()
+
+    def _get_sent_data(self) -> Message:
+        data = super()._get_sent_data()
+        if self.__phase == Phase.STAGE_ONE:
+            assert isinstance(data, ParameterMessage)
+            data.parameter = self.get_block_parameter(
+                parameter_dict=data.parameter, model_cache=self._model_cache
+            )
+            return data
+        data.in_round = True
+        data.other_data["check_acc"] = True
+        return data

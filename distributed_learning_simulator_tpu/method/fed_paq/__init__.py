@@ -1,0 +1,20 @@
+"""FedPAQ = FedAvg over quantized transport (QSGD stochastic codec)
+(reference ``simulation_lib/method/fed_paq/__init__.py:7-14``)."""
+
+from ...algorithm.fed_avg_algorithm import FedAVGAlgorithm
+from ...server.aggregation_server import AggregationServer
+from ...topology.quantized_endpoint import (
+    StochasticQuantClientEndpoint,
+    StochasticQuantServerEndpoint,
+)
+from ...worker.aggregation_worker import AggregationWorker
+from ..algorithm_factory import CentralizedAlgorithmFactory
+
+CentralizedAlgorithmFactory.register_algorithm(
+    algorithm_name="fed_paq",
+    client_cls=AggregationWorker,
+    server_cls=AggregationServer,
+    algorithm_cls=FedAVGAlgorithm,
+    client_endpoint_cls=StochasticQuantClientEndpoint,
+    server_endpoint_cls=StochasticQuantServerEndpoint,
+)

@@ -1,0 +1,17 @@
+"""Shapley-value contribution evaluation methods
+(reference ``simulation_lib/method/shapley_value/__init__.py:6-15``)."""
+
+from ...worker.aggregation_worker import AggregationWorker
+from ..algorithm_factory import CentralizedAlgorithmFactory
+from .servers import GTGShapleyValueServer, MultiRoundShapleyValueServer
+
+CentralizedAlgorithmFactory.register_algorithm(
+    algorithm_name="multiround_shapley_value",
+    client_cls=AggregationWorker,
+    server_cls=MultiRoundShapleyValueServer,
+)
+CentralizedAlgorithmFactory.register_algorithm(
+    algorithm_name="GTG_shapley_value",
+    client_cls=AggregationWorker,
+    server_cls=GTGShapleyValueServer,
+)

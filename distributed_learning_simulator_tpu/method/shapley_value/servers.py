@@ -1,0 +1,38 @@
+"""Shapley servers (reference
+``simulation_lib/method/shapley_value/shapley_value_server.py:4-7`` +
+``GTG_shapley_value_server.py:5-7`` + ``multiround_shapley_value_server.py:5-9``)."""
+
+from typing import Any
+
+from ...server.aggregation_server import AggregationServer
+from ...shapley.gtg_shapley_value import GTGShapleyValue
+from ...shapley.multiround_shapley_value import MultiRoundShapleyValue
+from .shapley_value_algorithm import ShapleyValueAlgorithm
+
+
+class ShapleyValueServer(AggregationServer):
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        self.need_init_performance = True
+
+
+class GTGShapleyValueAlgorithm(ShapleyValueAlgorithm):
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(GTGShapleyValue, *args, **kwargs)
+
+
+class MultiRoundShapleyValueAlgorithm(ShapleyValueAlgorithm):
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(MultiRoundShapleyValue, *args, **kwargs)
+
+
+class GTGShapleyValueServer(ShapleyValueServer):
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs, algorithm=GTGShapleyValueAlgorithm(server=self))
+
+
+class MultiRoundShapleyValueServer(ShapleyValueServer):
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(
+            **kwargs, algorithm=MultiRoundShapleyValueAlgorithm(server=self)
+        )

@@ -1,0 +1,102 @@
+"""Shapley plumbing shared by the GTG and multi-round methods.
+
+TPU-native equivalent of
+``simulation_lib/method/shapley_value/shapley_value_algorithm.py:13-92``:
+non-accumulating FedAvg whose ``aggregate_worker_data`` lazily builds the SV
+engine (players + round-0 metric, which exists because the server sets
+``need_init_performance``), computes per-round SVs with a metric callback
+that re-aggregates each player subset and runs central inference, optionally
+filters the round's aggregation to the best subset, and dumps
+``shapley_values.json`` on exit.
+"""
+
+import copy
+import json
+import os
+from typing import Any
+
+from ...algorithm.fed_avg_algorithm import FedAVGAlgorithm
+from ...message import Message
+from ...utils.logging import get_logger
+
+
+class ShapleyValueAlgorithm(FedAVGAlgorithm):
+    def __init__(self, sv_algorithm_cls: type, server=None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._server = server
+        self.accumulate = False
+        self.metric_type: str = "accuracy"
+        self.sv_algorithm = None
+        self.sv_algorithm_cls = sv_algorithm_cls
+        self.shapley_values: dict = {}
+        self.shapley_values_S: dict = {}
+
+    @property
+    def config(self):
+        return self._server.config
+
+    @property
+    def choose_best_subset(self) -> bool:
+        return self.config.algorithm_kwargs.get("choose_best_subset", False)
+
+    def _get_players(self):
+        return sorted(self._all_worker_data.keys())
+
+    def aggregate_worker_data(self) -> Message:
+        if self.sv_algorithm is None:
+            assert self._server.round_number == 1
+            self.sv_algorithm = self.sv_algorithm_cls(
+                players=self._get_players(),
+                last_round_metric=self._server.performance_stat[
+                    self._server.round_number - 1
+                ][f"test_{self.metric_type}"],
+                **self.config.algorithm_kwargs.get("sv_kwargs", {}),
+            )
+        self.sv_algorithm.set_metric_function(self._get_subset_metric)
+        self.sv_algorithm.compute(round_number=self._server.round_number)
+        round_number = self._server.round_number
+        self.shapley_values[round_number] = copy.deepcopy(
+            self._convert_shapley_values(
+                self.sv_algorithm.shapley_values[round_number]
+            )
+        )
+        self.shapley_values_S[round_number] = self._convert_shapley_values(
+            self.sv_algorithm.shapley_values_S[round_number]
+        )
+        if self.choose_best_subset:
+            best_subset = set(self.shapley_values_S[round_number].keys())
+            if best_subset:
+                get_logger().info("use subset %s", best_subset)
+                self._all_worker_data = {
+                    k: v for k, v in self._all_worker_data.items() if k in best_subset
+                }
+        return super().aggregate_worker_data()
+
+    def _convert_shapley_values(self, shapley_values: dict) -> dict:
+        return shapley_values
+
+    def _get_subset_metric(self, subset) -> float:
+        assert subset
+        worker_data = FedAVGAlgorithm._aggregate_worker_data(
+            {k: v for k, v in self._all_worker_data.items() if k in subset}
+        )
+        return self._server.get_metric(worker_data, keep_performance_logger=False)[
+            self.metric_type
+        ]
+
+    def exit(self) -> None:
+        if self.sv_algorithm is None:
+            return
+        with open(
+            os.path.join(self.config.save_dir, "shapley_values.json"),
+            "wt",
+            encoding="utf8",
+        ) as f:
+            json.dump({str(k): v for k, v in self.shapley_values.items()}, f)
+        if self.choose_best_subset:
+            with open(
+                os.path.join(self.config.save_dir, "shapley_values_S.json"),
+                "wt",
+                encoding="utf8",
+            ) as f:
+                json.dump({str(k): v for k, v in self.shapley_values_S.items()}, f)

@@ -1,0 +1,19 @@
+"""sign-SGD: per-step sign-compressed gradients with majority-vote
+aggregation (Bernstein et al., signSGD with majority vote).
+
+The reference ships configs (``conf/sign_sgd/*.yaml``) and the
+``GradientWorker`` substrate but the method registration itself was removed
+from the snapshot (SURVEY.md §2.9); this build supplies it as a first-class
+method, per BASELINE.json's north star.
+"""
+
+from ..algorithm_factory import CentralizedAlgorithmFactory
+from .server import GradientServer, SignSGDAlgorithm
+from .worker import SignSGDWorker
+
+CentralizedAlgorithmFactory.register_algorithm(
+    algorithm_name="sign_SGD",
+    client_cls=SignSGDWorker,
+    server_cls=GradientServer,
+    algorithm_cls=SignSGDAlgorithm,
+)

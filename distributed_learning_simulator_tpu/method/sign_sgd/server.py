@@ -1,0 +1,150 @@
+"""Gradient-aggregating server for per-step methods.
+
+The reference snapshot has no gradient server (the sign_SGD method was
+removed — SURVEY.md §3.5 note); this supplies one: gather all workers'
+gradient messages each optimizer step, aggregate (majority vote for
+sign-SGD), broadcast the result ``in_round``; stop when every worker has
+sent ``end_training``.
+"""
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ...algorithm.aggregation_algorithm import AggregationAlgorithm
+from ...message import Message
+from ...server.server import Server
+from ...utils.logging import get_logger
+
+
+@jax.jit
+def _majority_vote(stacked: jax.Array) -> jax.Array:
+    return jnp.sign(jnp.sum(stacked, axis=0))
+
+
+@functools.partial(jax.jit, static_argnums=())
+def _weighted_mean(stacked: jax.Array, weights: jax.Array) -> jax.Array:
+    weights = weights / jnp.sum(weights)
+    return jnp.einsum("w,wn->n", weights, stacked)
+
+
+class SignSGDAlgorithm(AggregationAlgorithm):
+    """Majority vote: sign of the sum of worker signs."""
+
+    use_majority_vote = True
+
+    def __init__(self, server=None) -> None:
+        super().__init__(server=server)
+        self.ended_workers: set[int] = set()
+
+    def process_worker_data(self, worker_id, worker_data, **kwargs) -> None:
+        if worker_data is not None and worker_data.end_training:
+            self.ended_workers.add(worker_id)
+        super().process_worker_data(worker_id, worker_data, **kwargs)
+
+    def aggregate_worker_data(self) -> Message:
+        gradient_messages = {
+            w: d
+            for w, d in self._all_worker_data.items()
+            if isinstance(d, Message) and "gradient" in d.other_data
+        }
+        if not gradient_messages:
+            return Message(end_training=True)
+        stacked = jnp.stack(
+            [gradient_messages[w].other_data["gradient"] for w in sorted(gradient_messages)]
+        )
+        if self.use_majority_vote:
+            aggregated = _majority_vote(stacked)
+        else:
+            weights = jnp.asarray(
+                [
+                    float(gradient_messages[w].other_data["dataset_size"])
+                    for w in sorted(gradient_messages)
+                ],
+                dtype=jnp.float32,
+            )
+            aggregated = _weighted_mean(stacked, weights)
+        return Message(in_round=True, other_data={"gradient": aggregated})
+
+
+class GradientServer(Server):
+    """Event loop over per-step gradient messages.
+
+    Workers may finish their epochs at different times (unequal batch
+    counts); an ``end_training`` message permanently retires a worker — each
+    optimizer step aggregates over the workers still running, and the loop
+    stops once every worker has retired.
+    """
+
+    def __init__(self, algorithm: AggregationAlgorithm, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._algorithm = algorithm
+        self._algorithm.set_server(self)
+        self._algorithm.set_config(self.config)
+        self._worker_flag: set[int] = set()
+        self._ended: set[int] = set()
+        self._end = False
+        self._round_number = 1
+        self._final_params = None
+        self._stat: dict[int, dict] = {}
+
+    @property
+    def algorithm(self) -> AggregationAlgorithm:
+        return self._algorithm
+
+    def _process_worker_data(self, worker_id: int, data: Message | None) -> None:
+        if data is not None and data.end_training:
+            self._ended.add(worker_id)
+            if getattr(data, "parameter", None):
+                self._final_params = data.parameter
+                data = Message(end_training=True, other_data=data.other_data)
+            self._algorithm.process_worker_data(worker_id=worker_id, worker_data=data)
+            if len(self._ended) >= self.worker_number:
+                self._end = True
+                get_logger().info("all workers ended; gradient server stops")
+            self._maybe_aggregate()
+            return
+        self._algorithm.process_worker_data(worker_id=worker_id, worker_data=data)
+        self._worker_flag.add(worker_id)
+        self._maybe_aggregate()
+
+    def _maybe_aggregate(self) -> None:
+        expected = self.worker_number - len(self._ended)
+        if expected == 0 or len(self._worker_flag) < expected:
+            return
+        result = self._algorithm.aggregate_worker_data()
+        if result.end_training:
+            self._end = True
+        else:
+            self._send_result(result)
+        self._worker_flag.clear()
+        self._algorithm.clear_worker_data()
+
+    def _active_workers(self) -> set[int]:
+        return set(range(self.worker_number)) - self._ended
+
+    def _select_workers(self) -> set[int]:
+        # per-step collectives reach every still-running worker
+        return set(range(self.worker_number)) - self._ended
+
+    def _stopped(self) -> bool:
+        return self._end
+
+    @property
+    def performance_stat(self) -> dict[int, dict]:
+        return self._stat
+
+    def _server_exit(self) -> None:
+        if self._final_params is not None:
+            import json
+            import os
+
+            metric = self.get_metric(self._final_params)
+            self._stat[1] = {f"test_{k}": v for k, v in metric.items()}
+            with open(
+                os.path.join(self.save_dir, "round_record.json"), "wt", encoding="utf8"
+            ) as f:
+                json.dump(self._stat, f)
+        self._algorithm.exit()

@@ -1,0 +1,13 @@
+"""sign-SGD client: ships sign(gradient) each optimizer step
+(reference substrate: ``simulation_lib/worker/gradient_worker.py:13-131``
+with ``_process_gradient`` = sign)."""
+
+import jax
+import jax.numpy as jnp
+
+from ...worker.gradient_worker import GradientWorker
+
+
+class SignSGDWorker(GradientWorker):
+    def _process_gradient(self, gradient: jax.Array) -> jax.Array:
+        return jnp.sign(gradient)

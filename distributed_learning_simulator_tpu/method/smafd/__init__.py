@@ -1,0 +1,20 @@
+"""single_model_afd: error-feedback sparsified (whole-tensor dropout) delta
+uploads.
+
+The reference ships configs (``conf/smafd/*.yaml``) and the building blocks
+(``ErrorFeedbackWorker``, ``RandomDropoutAlgorithm``) but the registration
+was removed from the snapshot (SURVEY.md §2.9); this build supplies the
+method first-class.
+"""
+
+from ...algorithm.fed_avg_algorithm import FedAVGAlgorithm
+from ...server.aggregation_server import AggregationServer
+from ..algorithm_factory import CentralizedAlgorithmFactory
+from .worker import SingleModelAFDWorker
+
+CentralizedAlgorithmFactory.register_algorithm(
+    algorithm_name="single_model_afd",
+    client_cls=SingleModelAFDWorker,
+    server_cls=AggregationServer,
+    algorithm_cls=FedAVGAlgorithm,
+)

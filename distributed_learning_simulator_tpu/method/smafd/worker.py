@@ -1,0 +1,26 @@
+"""single_model_afd client: random whole-tensor dropout of the parameter
+delta with error feedback (truncated deltas accumulate in the residual and
+are retried next round).  Logs ``send_num`` the way the reference's analysis
+cost model expects (``analysis/analyze_log.py:191-209``)."""
+
+from typing import Any
+
+from ...algorithm.random_dropout_algorithm import RandomDropoutAlgorithm
+from ...ops.pytree import Params
+from ...utils.logging import get_logger
+from ...worker.error_feedback_worker import ErrorFeedbackWorker
+
+
+class SingleModelAFDWorker(ErrorFeedbackWorker):
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._dropout = RandomDropoutAlgorithm(
+            dropout_rate=self.config.algorithm_kwargs["dropout_rate"],
+            seed=self.config.seed * 31 + self.worker_id,
+        )
+
+    def _sparsify(self, delta: Params) -> Params:
+        sent = self._dropout.drop_parameters(delta)
+        send_num = sum(int(v.size) for v in sent.values())
+        get_logger().info("send_num %s", send_num)
+        return sent

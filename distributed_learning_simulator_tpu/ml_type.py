@@ -1,0 +1,35 @@
+"""Core enums and exceptions.
+
+TPU-native equivalent of ``cyy_torch_toolbox.ml_type`` (imported by the
+reference's workers, e.g. ``simulation_lib/worker/aggregation_worker.py:4``).
+"""
+
+import enum
+
+
+class MachineLearningPhase(enum.StrEnum):
+    Training = "training"
+    Validation = "validation"
+    Test = "test"
+
+
+class ExecutorHookPoint(enum.StrEnum):
+    """Hook points fired by the trainer engine (reference hook points used:
+    AFTER_BATCH, AFTER_EPOCH, AFTER_EXECUTE, OPTIMIZER_STEP — SURVEY.md §2.13)."""
+
+    BEFORE_EXECUTE = "before_execute"
+    BEFORE_EPOCH = "before_epoch"
+    BEFORE_BATCH = "before_batch"
+    AFTER_BATCH = "after_batch"
+    OPTIMIZER_STEP = "optimizer_step"
+    AFTER_EPOCH = "after_epoch"
+    AFTER_EXECUTE = "after_execute"
+
+
+class StopExecutingException(Exception):
+    """Raised by hooks to stop the executor (reference:
+    ``cyy_torch_toolbox.ml_type.StopExecutingException``)."""
+
+
+class TaskAbortedError(Exception):
+    """Internal: another executor of the task failed; unwind this thread."""

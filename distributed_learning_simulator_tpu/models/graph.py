@@ -1,0 +1,117 @@
+"""Graph models (flax.linen).
+
+``TwoGCN`` / ``SimpleGCN`` mirror the reference's federated-GNN configs
+(``conf/fed_gnn/cs.yaml: TwoGCN``, ``conf/fed_aas/cora.yaml: SimpleGCN``; the
+reference imports them from ``torch_geometric`` — ``graph_worker.py:375-380``).
+GCN convolution is expressed with ``jax.ops.segment_sum`` over a static-shape
+``edge_index`` + per-edge mask (jraph-style), which XLA lowers to efficient
+scatter/gather — no sparse-matrix library needed, and masked edges make
+subgraph pruning a weight change instead of a shape change (SPMD-friendly).
+
+Each model exposes ``embed`` (first message-passing layer) and ``head`` (the
+rest) so federated boundary-embedding exchange can be injected between the
+layers — the functional analogue of the reference's forward-pre-hooks on
+``MessagePassing`` modules (``graph_worker.py:344-373``).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .registry import ModelContext, register_model
+
+
+def gcn_conv(x, edge_index, edge_mask, weight_fn, num_nodes: int):
+    """Symmetric-normalized GCN aggregation with self-loops; ``weight_fn``
+    is the dense transform applied before propagation."""
+    x = weight_fn(x)
+    src, dst = edge_index[0], edge_index[1]
+    ones = jnp.ones(src.shape[0], dtype=jnp.float32)
+    if edge_mask is not None:
+        ones = ones * edge_mask.astype(jnp.float32)
+    deg = jax.ops.segment_sum(ones, dst, num_segments=num_nodes) + 1.0
+    inv_sqrt = jax.lax.rsqrt(deg)
+    coeff = inv_sqrt[src] * inv_sqrt[dst] * ones
+    messages = x[src] * coeff[:, None]
+    agg = jax.ops.segment_sum(messages, dst, num_segments=num_nodes)
+    return agg + x * (1.0 / deg)[:, None]  # self-loop term
+
+
+class GCNLayer(nn.Module):
+    features: int
+
+    @nn.compact
+    def __call__(self, x, edge_index, edge_mask=None):
+        dense = nn.Dense(self.features, use_bias=False)
+        out = gcn_conv(x, edge_index, edge_mask, dense, x.shape[0])
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        return out + bias
+
+
+class TwoGCN(nn.Module):
+    num_classes: int
+    hidden: int = 64
+    dropout_rate: float = 0.5
+
+    def setup(self) -> None:
+        self.conv1 = GCNLayer(self.hidden)
+        self.conv2 = GCNLayer(self.num_classes)
+        self.dropout = nn.Dropout(self.dropout_rate)
+
+    def embed(self, inputs, train: bool = False):
+        x = self.conv1(inputs["x"], inputs["edge_index"], inputs.get("edge_mask"))
+        return nn.relu(x)
+
+    def head(self, h, inputs, train: bool = False):
+        h = self.dropout(h, deterministic=not train)
+        return self.conv2(h, inputs["edge_index"], inputs.get("edge_mask"))
+
+    def __call__(self, inputs, train: bool = False):
+        return self.head(self.embed(inputs, train=train), inputs, train=train)
+
+
+class SimpleGCN(nn.Module):
+    num_classes: int
+    hidden: int = 64
+
+    def setup(self) -> None:
+        self.conv1 = GCNLayer(self.hidden)
+        self.out = nn.Dense(self.num_classes)
+
+    def embed(self, inputs, train: bool = False):
+        x = self.conv1(inputs["x"], inputs["edge_index"], inputs.get("edge_mask"))
+        return nn.relu(x)
+
+    def head(self, h, inputs, train: bool = False):
+        return self.out(h)
+
+    def __call__(self, inputs, train: bool = False):
+        return self.head(self.embed(inputs, train=train), inputs, train=train)
+
+
+def _graph_context(name: str, module, dataset_collection) -> ModelContext:
+    from ..ml_type import MachineLearningPhase as Phase
+
+    dataset = dataset_collection.get_dataset(Phase.Training)
+    example = {k: v for k, v in dataset.inputs.items() if k != "mask"}
+    return ModelContext(
+        name=name,
+        module=module,
+        example_input=example,
+        num_classes=dataset_collection.num_classes,
+        dataset_type="graph",
+    )
+
+
+@register_model("TwoGCN", "twogcn")
+def _two_gcn(dataset_collection, hidden: int = 64, **kwargs) -> ModelContext:
+    return _graph_context(
+        "TwoGCN", TwoGCN(dataset_collection.num_classes, hidden), dataset_collection
+    )
+
+
+@register_model("SimpleGCN", "simplegcn")
+def _simple_gcn(dataset_collection, hidden: int = 64, **kwargs) -> ModelContext:
+    return _graph_context(
+        "SimpleGCN", SimpleGCN(dataset_collection.num_classes, hidden), dataset_collection
+    )

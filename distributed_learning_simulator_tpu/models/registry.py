@@ -1,0 +1,90 @@
+"""Model registry + ModelContext.
+
+TPU-native equivalent of the reference's model zoo, which is registered by
+importing ``cyy_torch_vision``/``cyy_torch_text``/``cyy_torch_graph``
+(``common_import.py:1-16``); model names come from ``conf/**`` YAMLs
+(LeNet5, densenet40, TransformerClassificationModel, TwoGCN, SimpleGCN, ...).
+
+A :class:`ModelContext` bundles the flax module with pure functions
+(init / apply / loss) over **flat** parameter dicts (see ``ops/pytree.py``),
+which is the currency of the whole framework.
+"""
+
+import dataclasses
+from collections.abc import Callable
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.collection import DatasetCollection
+from ..ops.pytree import Params, flatten_nested, unflatten_nested
+
+global_model_factory: dict[str, Callable[..., "ModelContext"]] = {}
+
+
+def register_model(*names: str):
+    def deco(fn):
+        for name in names:
+            global_model_factory[name.lower()] = fn
+        return fn
+
+    return deco
+
+
+@dataclasses.dataclass
+class ModelContext:
+    name: str
+    module: Any  # flax linen module
+    example_input: Any  # one example batch input (numpy, leading dim 1)
+    num_classes: int
+    dataset_type: str = "vision"
+    loss_type: str = "softmax_ce"
+    compute_dtype: Any = jnp.float32
+
+    def init(self, rng: jax.Array) -> Params:
+        example = jax.tree.map(jnp.asarray, self.example_input)
+        variables = self.module.init(rng, example, train=False)
+        return flatten_nested(variables["params"])
+
+    def apply(self, params: Params, inputs, train: bool = False, rngs=None):
+        variables = {"params": unflatten_nested(params)}
+        return self.module.apply(variables, inputs, train=train, rngs=rngs)
+
+    def loss(self, params: Params, batch: dict, train: bool = False, rngs=None):
+        """Masked mean softmax cross-entropy + accuracy counts.
+
+        ``batch`` = {"input", "target", "mask"}; mask weights padded samples 0.
+        """
+        logits = self.apply(params, batch["input"], train=train, rngs=rngs)
+        return masked_ce_loss(logits, batch["target"], batch["mask"])
+
+
+def masked_ce_loss(logits, targets, mask):
+    mask = mask.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(logp, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    total = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / total
+    correct = ((jnp.argmax(logits, axis=-1) == targets) * mask).sum()
+    return loss, {"loss_sum": nll * mask, "correct": correct, "count": mask.sum()}
+
+
+def create_model_context(
+    model_name: str, dataset_collection: DatasetCollection, **model_kwargs
+) -> ModelContext:
+    factory = global_model_factory.get(model_name.lower())
+    if factory is None:
+        raise KeyError(f"unknown model {model_name!r}; known: {sorted(global_model_factory)}")
+    return factory(dataset_collection=dataset_collection, **model_kwargs)
+
+
+def example_batch(dc: DatasetCollection) -> np.ndarray:
+    from ..ml_type import MachineLearningPhase as Phase
+
+    phase = Phase.Training if dc.has_dataset(Phase.Training) else Phase.Test
+    dataset = dc.get_dataset(phase)
+    if isinstance(dataset.inputs, dict):
+        return dataset.inputs
+    return dataset.inputs[:1]

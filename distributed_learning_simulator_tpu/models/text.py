@@ -1,0 +1,98 @@
+"""Text models (flax.linen).
+
+``TransformerClassificationModel`` mirrors the reference's IMDB classifier
+(``conf/fed_avg/imdb.yaml``: d_model=100, nhead=5, num_encoder_layer=2,
+max_len=300, GloVe word vectors).  With zero egress there are no pretrained
+GloVe vectors; embeddings are learned from scratch (same shape).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import ModelContext, example_batch, register_model
+
+
+def sinusoidal_positions(max_len: int, d_model: int) -> np.ndarray:
+    pos = np.arange(max_len)[:, None]
+    dim = np.arange(0, d_model, 2)[None, :]
+    angle = pos / np.power(10000.0, dim / d_model)
+    enc = np.zeros((max_len, d_model), dtype=np.float32)
+    enc[:, 0::2] = np.sin(angle)
+    enc[:, 1::2] = np.cos(angle)[:, : enc[:, 1::2].shape[1]]
+    return enc
+
+
+class EncoderLayer(nn.Module):
+    d_model: int
+    nhead: int
+    dim_feedforward: int
+    dropout_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, x, pad_mask, train: bool = False):
+        attn_mask = pad_mask[:, None, None, :]  # [B, 1, 1, L] keyed on keys
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.nhead,
+            qkv_features=self.d_model,
+            deterministic=not train,
+            dropout_rate=self.dropout_rate,
+        )(x, x, mask=attn_mask)
+        x = nn.LayerNorm()(x + y)
+        y = nn.Dense(self.dim_feedforward)(x)
+        y = nn.relu(y)
+        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        y = nn.Dense(self.d_model)(y)
+        return nn.LayerNorm()(x + y)
+
+
+class TransformerClassifier(nn.Module):
+    vocab_size: int
+    num_classes: int
+    d_model: int = 100
+    nhead: int = 5
+    num_encoder_layer: int = 2
+    max_len: int = 300
+    pad_id: int = 0
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        pad_mask = tokens != self.pad_id  # [B, L]
+        x = nn.Embed(self.vocab_size, self.d_model)(tokens)
+        x = x + sinusoidal_positions(self.max_len, self.d_model)[None, : tokens.shape[1]]
+        for _ in range(self.num_encoder_layer):
+            x = EncoderLayer(self.d_model, self.nhead, 4 * self.d_model)(
+                x, pad_mask, train=train
+            )
+        denom = jnp.maximum(pad_mask.sum(axis=1, keepdims=True), 1)
+        pooled = (x * pad_mask[..., None]).sum(axis=1) / denom
+        return nn.Dense(self.num_classes)(pooled)
+
+
+@register_model("TransformerClassificationModel", "transformerclassificationmodel")
+def _transformer(
+    dataset_collection,
+    d_model: int = 100,
+    nhead: int = 5,
+    num_encoder_layer: int = 2,
+    max_len: int = 0,
+    word_vector_name: str = "",
+    **kwargs,
+) -> ModelContext:
+    meta = dataset_collection.metadata
+    module = TransformerClassifier(
+        vocab_size=meta.get("vocab_size", 20000),
+        num_classes=dataset_collection.num_classes,
+        d_model=d_model,
+        nhead=nhead,
+        num_encoder_layer=num_encoder_layer,
+        max_len=max_len or meta.get("max_len", 300),
+        pad_id=meta.get("pad_id", 0),
+    )
+    return ModelContext(
+        name="TransformerClassificationModel",
+        module=module,
+        example_input=example_batch(dataset_collection),
+        num_classes=dataset_collection.num_classes,
+        dataset_type="text",
+    )

@@ -1,0 +1,169 @@
+"""Vision models (flax.linen).
+
+Names mirror the reference's conf/** zoo: LeNet5 (``conf/fed_avg/mnist.yaml``),
+densenet40 (``conf/fed_obd/cifar10.yaml``), plus ResNet variants.  Norm layers
+are GroupNorm, not BatchNorm: the reference disables BN running stats on every
+parameter load (``simulation_lib/util/model.py:6-23``), and stateless norms
+keep client state = params only, which the whole-client ``vmap``/``shard_map``
+fast path relies on.  Convolutions run in NHWC (TPU-native layout) with
+bfloat16-friendly defaults.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .registry import ModelContext, example_batch, register_model
+
+
+def _gn_groups(channels: int) -> int:
+    """Largest group count <= 8 that divides the channel count."""
+    for groups in range(min(8, channels), 0, -1):
+        if channels % groups == 0:
+            return groups
+    return 1
+
+
+class LeNet5(nn.Module):
+    """Classic LeNet-5 for 28x28 inputs."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(6, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID")(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120)(x))
+        x = nn.relu(nn.Dense(84)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class DenseLayer(nn.Module):
+    growth_rate: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = nn.GroupNorm(num_groups=_gn_groups(x.shape[-1]))(x)
+        y = nn.relu(y)
+        y = nn.Conv(self.growth_rate, (3, 3), padding="SAME", use_bias=False)(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class TransitionLayer(nn.Module):
+    out_features: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.GroupNorm(num_groups=_gn_groups(x.shape[-1]))(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.out_features, (1, 1), use_bias=False)(x)
+        return nn.avg_pool(x, (2, 2), strides=(2, 2))
+
+
+class DenseNet40(nn.Module):
+    """DenseNet-40 (k=12, 3 dense blocks of 12 layers) as used by the
+    reference's CIFAR configs (``conf/fed_obd/cifar10.yaml: densenet40``)."""
+
+    num_classes: int = 10
+    growth_rate: int = 12
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
+        for block in range(3):
+            for _ in range(12):
+                x = DenseLayer(self.growth_rate)(x, train=train)
+            if block < 2:
+                x = TransitionLayer(x.shape[-1] // 2)(x, train=train)
+        x = nn.GroupNorm(num_groups=_gn_groups(x.shape[-1]))(x)
+        x = nn.relu(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+class ResNetBlock(nn.Module):
+    features: int
+    strides: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.features, (3, 3), self.strides, padding="SAME", use_bias=False)(x)
+        y = nn.GroupNorm(num_groups=_gn_groups(self.features))(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=_gn_groups(self.features))(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.features, (1, 1), self.strides, use_bias=False, name="shortcut"
+            )(x)
+            residual = nn.GroupNorm(num_groups=_gn_groups(self.features))(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    num_classes: int = 10
+    stage_sizes: tuple[int, ...] = (2, 2, 2, 2)
+    width: int = 64
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=_gn_groups(self.width))(x)
+        x = nn.relu(x)
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            features = self.width * (2**stage)
+            for block in range(n_blocks):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = ResNetBlock(features, strides)(x, train=train)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+@register_model("LeNet5", "lenet5")
+def _lenet5(dataset_collection, **kwargs) -> ModelContext:
+    module = LeNet5(num_classes=dataset_collection.num_classes)
+    return ModelContext(
+        name="LeNet5",
+        module=module,
+        example_input=example_batch(dataset_collection),
+        num_classes=dataset_collection.num_classes,
+    )
+
+
+@register_model("densenet40")
+def _densenet40(dataset_collection, **kwargs) -> ModelContext:
+    module = DenseNet40(num_classes=dataset_collection.num_classes)
+    return ModelContext(
+        name="densenet40",
+        module=module,
+        example_input=example_batch(dataset_collection),
+        num_classes=dataset_collection.num_classes,
+    )
+
+
+@register_model("resnet18", "ResNet18")
+def _resnet18(dataset_collection, **kwargs) -> ModelContext:
+    module = ResNet(num_classes=dataset_collection.num_classes, stage_sizes=(2, 2, 2, 2))
+    return ModelContext(
+        name="resnet18",
+        module=module,
+        example_input=example_batch(dataset_collection),
+        num_classes=dataset_collection.num_classes,
+    )
+
+
+@register_model("resnet50", "ResNet50")
+def _resnet50(dataset_collection, **kwargs) -> ModelContext:
+    # bottleneck-free deep variant; stands in for the reference zoo's ResNet50
+    module = ResNet(num_classes=dataset_collection.num_classes, stage_sizes=(3, 4, 6, 3))
+    return ModelContext(
+        name="resnet50",
+        module=module,
+        example_input=example_batch(dataset_collection),
+        num_classes=dataset_collection.num_classes,
+    )

@@ -1,0 +1,29 @@
+from .pytree import (
+    Params,
+    cat_params_to_vector,
+    param_nbytes,
+    params_add,
+    params_diff,
+    params_from_vector_like,
+    params_l2,
+    params_scale,
+    params_zeros_like,
+    tree_cast,
+    tree_to_numpy,
+    weighted_sum,
+)
+
+__all__ = [
+    "Params",
+    "cat_params_to_vector",
+    "param_nbytes",
+    "params_add",
+    "params_diff",
+    "params_from_vector_like",
+    "params_l2",
+    "params_scale",
+    "params_zeros_like",
+    "tree_cast",
+    "tree_to_numpy",
+    "weighted_sum",
+]

@@ -1,0 +1,114 @@
+"""Flat-parameter-dict ("pytree") utilities.
+
+TPU-native equivalent of the reference's tensor helpers
+(``cyy_torch_toolbox.tensor``: ``cat_tensors_to_vector``,
+``decompose_tensor_to_list``, ``recursive_tensor_op``, and the ``TensorDict``
+alias — see SURVEY.md §2.13).  Model parameters are represented everywhere as
+a flat ``dict[str, jax.Array]`` keyed by "/"-joined module paths (mirroring
+the reference's module-path-keyed ``TensorDict``), which makes block
+partitioning (FedOBD), per-tensor dropout, and parameter diffs natural.
+"""
+
+from collections.abc import Mapping
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = dict[str, jax.Array]
+
+
+def flatten_nested(nested: Mapping[str, Any], sep: str = "/") -> Params:
+    """Flatten a nested param dict (e.g. flax ``params``) into flat path keys."""
+    out: Params = {}
+
+    def rec(prefix: str, node: Any) -> None:
+        if isinstance(node, Mapping):
+            for k in sorted(node.keys()):
+                rec(f"{prefix}{sep}{k}" if prefix else str(k), node[k])
+        else:
+            out[prefix] = node
+
+    rec("", nested)
+    return out
+
+
+def unflatten_nested(flat: Mapping[str, Any], sep: str = "/") -> dict[str, Any]:
+    """Inverse of :func:`flatten_nested`."""
+    out: dict[str, Any] = {}
+    for key, value in flat.items():
+        parts = key.split(sep)
+        node = out
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return out
+
+
+def cat_params_to_vector(params: Mapping[str, jax.Array]) -> jax.Array:
+    """Concatenate all tensors into one flat vector, keys sorted
+    (reference: ``cat_tensors_to_vector`` used by ``gradient_worker.py``)."""
+    return jnp.concatenate([jnp.ravel(params[k]) for k in sorted(params)])
+
+
+def params_from_vector_like(vector: jax.Array, like: Params) -> Params:
+    """Split a flat vector back into a param dict with ``like``'s shapes
+    (reference: ``decompose_tensor_to_list``)."""
+    out: Params = {}
+    offset = 0
+    for key in sorted(like):
+        shape = like[key].shape
+        size = int(np.prod(shape)) if shape else 1
+        out[key] = jax.lax.dynamic_slice_in_dim(vector, offset, size).reshape(shape)
+        offset += size
+    return out
+
+
+def params_diff(new: Params, old: Params) -> Params:
+    return {k: new[k] - old[k] for k in new}
+
+
+def params_add(base: Params, delta: Mapping[str, jax.Array]) -> Params:
+    return {k: (base[k] + delta[k]) if k in delta else base[k] for k in base}
+
+
+def params_scale(params: Params, scale) -> Params:
+    return {k: v * scale for k, v in params.items()}
+
+
+def params_zeros_like(params: Params) -> Params:
+    return {k: jnp.zeros_like(v) for k, v in params.items()}
+
+
+def params_l2(params: Params) -> jax.Array:
+    return jnp.sqrt(sum(jnp.sum(jnp.square(v.astype(jnp.float32))) for v in params.values()))
+
+
+def weighted_sum(param_list: list[Params], weights) -> Params:
+    """``sum_i params_i * w_i`` over a python list of param dicts."""
+    keys = param_list[0].keys()
+    return {
+        k: sum(p[k].astype(jnp.float32) * w for p, w in zip(param_list, weights))
+        for k in keys
+    }
+
+
+def tree_cast(tree, dtype):
+    return jax.tree.map(lambda x: x.astype(dtype), tree)
+
+
+def tree_to_numpy(tree):
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def param_nbytes(tree: Any) -> int:
+    """Total payload bytes of a pytree of arrays
+    (reference: ``get_message_size``, ``simulation_lib/message.py:52-62``)."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+        elif hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+            total += int(leaf.size) * np.dtype(leaf.dtype).itemsize
+    return total

@@ -1,0 +1,4 @@
+from .mesh import make_mesh
+from .spmd import SpmdFedAvgSession
+
+__all__ = ["make_mesh", "SpmdFedAvgSession"]

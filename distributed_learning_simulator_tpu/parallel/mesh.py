@@ -1,0 +1,30 @@
+"""Device mesh construction.
+
+The client axis of federated learning maps onto the hardware mesh
+(SURVEY.md §5: "clients = leading pytree axis sharded over ICI").  On a
+multi-host pod, ``jax.distributed`` has already made every chip visible;
+here we only shape the axes: ``clients`` (data/client parallelism, rides
+ICI) and an optional inner ``model`` axis for TP/FSDP of large client
+models.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(model_parallel: int = 1, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    assert n % model_parallel == 0, (n, model_parallel)
+    grid = np.asarray(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(grid, axis_names=("clients", "model"))
+
+
+def client_slots(worker_number: int, mesh: Mesh) -> int:
+    """Pad the client count to a multiple of the mesh's client axis so every
+    device carries the same number of client slots (zero-weight padding
+    mirrors the reference's time-multiplexing of workers onto devices,
+    ``algorithm_factory.py:38-58``)."""
+    n = mesh.shape["clients"]
+    return ((worker_number + n - 1) // n) * n

@@ -1,0 +1,258 @@
+"""The SPMD fast path: one XLA program per federated round.
+
+This is the heart of the TPU-first design (SURVEY.md §7): instead of N
+worker threads time-sharing the chip (the simulation-faithful path in
+``training.py``), the whole round — **every selected client's local epochs
+plus the weighted FedAvg reduction** — is a single jitted program laid out
+over a ``Mesh(("clients", "model"))``:
+
+* client state (params, opt-state, rng) and client data are stacked on a
+  leading ``clients`` axis, sharded over the mesh's ``clients`` axis;
+* local training is ``vmap`` over the per-device client slots inside
+  ``shard_map``; epochs/batches are ``lax.scan`` — no host round-trips;
+* aggregation is a weighted ``psum`` over ICI — the reference's
+  pipe-and-pickle hot loop (``server/server.py:64-85``) becomes one
+  collective;
+* client selection is a 0/1 weight mask (SURVEY.md §5 "treat selection as
+  masking"), so the compiled program is round-invariant.
+
+The host keeps the reference's control surface: per-round selection,
+round_record.json, best-model artifact, early stop.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import DistributedTrainingConfig
+from ..engine.batching import fixed_size_partition
+from ..engine.engine import ComputeEngine, summarize_metrics
+from ..ml_type import MachineLearningPhase as Phase
+from ..utils.logging import get_logger
+from .mesh import client_slots, make_mesh
+
+
+class SpmdFedAvgSession:
+    """FedAvg-family rounds as single SPMD programs.
+
+    Supported method semantics: fed_avg (full/delta uploads are equivalent
+    under full participation averaging) with random client selection.
+    """
+
+    def __init__(
+        self,
+        config: DistributedTrainingConfig,
+        dataset_collection,
+        model_ctx,
+        engine: ComputeEngine,
+        practitioners,
+        mesh: Mesh | None = None,
+    ) -> None:
+        self.config = config
+        self.dc = dataset_collection
+        self.model_ctx = model_ctx
+        self.engine = engine
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_slots = client_slots(config.worker_number, self.mesh)
+        self._stat: dict[int, dict] = {}
+        self._max_acc = 0.0
+
+        # ---- stack per-client data [C, n_batches, B, ...] ----
+        train = dataset_collection.get_dataset(Phase.Training)
+        batch_size = config.batch_size
+        sizes = []
+        per_client_indices = []
+        for practitioner in sorted(practitioners, key=lambda p: p.worker_id):
+            sampler = practitioner.get_sampler(config.dataset_name)
+            idx = sampler.sample(practitioner.practitioner_id)[Phase.Training]
+            per_client_indices.append(idx)
+            sizes.append(len(idx))
+        max_size = max(sizes)
+        n_batches = max(1, (max_size + batch_size - 1) // batch_size)
+        slot_size = n_batches * batch_size
+
+        inputs, targets, masks = [], [], []
+        for idx in per_client_indices:
+            padded, mask = fixed_size_partition(idx, slot_size)
+            inputs.append(train.inputs[padded])
+            targets.append(train.targets[padded])
+            masks.append(mask)
+        while len(inputs) < self.n_slots:  # zero-weight padding slots
+            inputs.append(np.zeros_like(inputs[0]))
+            targets.append(np.zeros_like(targets[0]))
+            masks.append(np.zeros_like(masks[0]))
+
+        def stack(parts, extra_shape):
+            arr = np.stack(parts).reshape(
+                self.n_slots, n_batches, batch_size, *extra_shape
+            )
+            return arr
+
+        self._data = {
+            "input": stack(inputs, train.inputs.shape[1:]),
+            "target": stack(targets, ()),
+            "mask": stack(masks, ()),
+        }
+        self._dataset_sizes = np.asarray(
+            sizes + [0] * (self.n_slots - len(sizes)), np.float32
+        )
+        self.n_batches = n_batches
+
+        # ---- shardings ----
+        self._client_sharding = NamedSharding(self.mesh, P("clients"))
+        self._replicated = NamedSharding(self.mesh, P())
+        self._data = jax.device_put(
+            self._data,
+            NamedSharding(self.mesh, P("clients")),
+        )
+
+        self._round_fn = self._build_round_fn()
+
+    # ------------------------------------------------------------------
+    def _build_round_fn(self):
+        engine = self.engine
+        epochs = self.config.epoch
+        n_slots_local = self.n_slots // self.mesh.shape["clients"]
+
+        def local_train(global_params, data, weight, rng):
+            """One client slot: E epochs of minibatch SGD from the fresh
+            global params (AggregationWorker semantics: optimizer state is
+            rebuilt each round, ``util/model.py:6-23``)."""
+            params = global_params
+            opt_state = engine.optimizer.init(params)
+
+            def epoch_body(carry, epoch_rng):
+                params, opt_state = carry
+                params, opt_state, metrics = engine.train_epoch_fn(
+                    params, opt_state, data, epoch_rng
+                )
+                return (params, opt_state), metrics
+
+            epoch_rngs = jax.random.split(rng, epochs)
+            (params, opt_state), metrics = jax.lax.scan(
+                epoch_body, (params, opt_state), epoch_rngs
+            )
+            summed = jax.tree.map(lambda x: jnp.sum(x), metrics)
+            # weighted contribution; unselected slots contribute zero
+            contribution = jax.tree.map(
+                lambda p: p.astype(jnp.float32) * weight, params
+            )
+            return contribution, summed
+
+        def round_program(global_params, weights, rngs):
+            """shard_map body: vmap local clients, psum the reduction."""
+
+            def shard_body(global_params, data, weights, rngs):
+                contributions, metrics = jax.vmap(
+                    local_train, in_axes=(None, 0, 0, 0)
+                )(global_params, data, weights, rngs)
+                local_sum = jax.tree.map(
+                    lambda c: jnp.sum(c, axis=0), contributions
+                )
+                global_sum = jax.tree.map(
+                    lambda s: jax.lax.psum(s, axis_name="clients"), local_sum
+                )
+                total_weight = jax.lax.psum(jnp.sum(weights), axis_name="clients")
+                new_global = jax.tree.map(
+                    lambda s, g: (s / jnp.maximum(total_weight, 1e-12)).astype(g.dtype),
+                    global_sum,
+                    global_params,
+                )
+                metrics = jax.tree.map(
+                    lambda m: jax.lax.psum(jnp.sum(m), axis_name="clients"), metrics
+                )
+                return new_global, metrics
+
+            try:
+                from jax import shard_map
+
+                compat = {"check_vma": False}
+            except ImportError:  # older jax
+                from jax.experimental.shard_map import shard_map
+
+                compat = {"check_rep": False}
+
+            return shard_map(
+                shard_body,
+                mesh=self.mesh,
+                in_specs=(P(), P("clients"), P("clients"), P("clients")),
+                out_specs=(P(), P()),
+                **compat,
+            )(global_params, self._data, weights, rngs)
+
+        return jax.jit(round_program)
+
+    # ------------------------------------------------------------------
+    def _select_weights(self, round_number: int) -> np.ndarray:
+        from ..utils.selection import select_workers
+
+        selected = select_workers(
+            self.config.seed,
+            round_number,
+            self.config.worker_number,
+            self.config.algorithm_kwargs.get("random_client_number"),
+        )
+        weights = np.zeros(self.n_slots, np.float32)
+        for worker_id in selected:
+            weights[worker_id] = self._dataset_sizes[worker_id]
+        return weights
+
+    def run(self) -> dict:
+        config = self.config
+        global_params = jax.device_put(
+            self.engine.init_params(config.seed), self._replicated
+        )
+        eval_batches = None
+        save_dir = os.path.join(config.save_dir, "server")
+        os.makedirs(save_dir, exist_ok=True)
+        rng = jax.random.PRNGKey(config.seed)
+        for round_number in range(1, config.round + 1):
+            weights = jax.device_put(
+                self._select_weights(round_number), self._client_sharding
+            )
+            rng, round_rng = jax.random.split(rng)
+            client_rngs = jax.device_put(
+                jax.random.split(round_rng, self.n_slots), self._client_sharding
+            )
+            global_params, train_metrics = self._round_fn(
+                global_params, weights, client_rngs
+            )
+            metric = self._evaluate(global_params)
+            self._record(round_number, metric, global_params, save_dir)
+        return {"performance": self._stat}
+
+    def _evaluate(self, global_params) -> dict:
+        from ..engine.batching import make_epoch_batches
+
+        test = self.dc.get_dataset(Phase.Test)
+        batches = make_epoch_batches(test, self.config.batch_size)
+        summed = self.engine.evaluate(global_params, batches)
+        return summarize_metrics(summed)
+
+    def _record(self, round_number, metric, global_params, save_dir) -> None:
+        round_stat = {f"test_{k}": v for k, v in metric.items()}
+        self._stat[round_number] = round_stat
+        get_logger().info(
+            "round: %d, test accuracy %.4f loss %.4f (spmd)",
+            round_number,
+            metric["accuracy"],
+            metric["loss"],
+        )
+        with open(
+            os.path.join(save_dir, "round_record.json"), "wt", encoding="utf8"
+        ) as f:
+            json.dump(self._stat, f)
+        if metric["accuracy"] > self._max_acc:
+            self._max_acc = metric["accuracy"]
+            np.savez(
+                os.path.join(save_dir, "best_global_model.npz"),
+                **{k: np.asarray(v) for k, v in global_params.items()},
+            )
+
+    @property
+    def performance_stat(self) -> dict:
+        return self._stat

@@ -1,0 +1,64 @@
+"""Federated participants.
+
+TPU-native equivalent of ``simulation_lib/practitioner.py:5-35``: a
+``Practitioner`` is a stable participant identity (``practitioner_id``) bound
+per task to a ``worker_id`` slot, holding its partition of each dataset via a
+shared sampler.
+"""
+
+from .config import DistributedTrainingConfig
+from .data import DatasetCollection, create_dataset_collection
+from .sampler import DatasetCollectionSampler, get_dataset_collection_sampler
+
+
+class Practitioner:
+    def __init__(self, practitioner_id: int) -> None:
+        self.practitioner_id = practitioner_id
+        self._worker_id: int | None = None
+        self._samplers: dict[str, DatasetCollectionSampler] = {}
+
+    @property
+    def worker_id(self) -> int:
+        assert self._worker_id is not None
+        return self._worker_id
+
+    def set_worker_id(self, worker_id: int) -> None:
+        self._worker_id = worker_id
+
+    def set_sampler(self, dataset_name: str, sampler: DatasetCollectionSampler) -> None:
+        self._samplers[dataset_name] = sampler
+
+    def has_dataset(self, dataset_name: str) -> bool:
+        return dataset_name in self._samplers
+
+    def get_sampler(self, dataset_name: str) -> DatasetCollectionSampler:
+        return self._samplers[dataset_name]
+
+    def create_dataset_collection(
+        self, config: DistributedTrainingConfig
+    ) -> DatasetCollection:
+        """This practitioner's local view of the dataset (reference
+        ``Practitioner.create_trainer`` subsets the toolbox trainer's dataset,
+        ``practitioner.py:29-35``)."""
+        sampler = self._samplers[config.dataset_name]
+        return sampler.sample_dataset(self.practitioner_id)
+
+
+def create_practitioners(config: DistributedTrainingConfig) -> set[Practitioner]:
+    """Build ``worker_number`` practitioners sharing one sampler
+    (reference ``config.create_practitioners``, ``config.py:55-72``)."""
+    dc = create_dataset_collection(config)
+    sampler = get_dataset_collection_sampler(
+        config.dataset_sampling,
+        dc,
+        config.worker_number,
+        seed=config.seed,
+        **dict(config.dataset_sampling_kwargs),
+    )
+    practitioners = set()
+    for practitioner_id in range(config.worker_number):
+        practitioner = Practitioner(practitioner_id)
+        practitioner.set_sampler(config.dataset_name, sampler)
+        practitioner.set_worker_id(practitioner_id)
+        practitioners.add(practitioner)
+    return practitioners

@@ -1,0 +1,15 @@
+from .base import (
+    DatasetCollectionSampler,
+    IIDSampler,
+    RandomLabelIIDSplit,
+    get_dataset_collection_sampler,
+    global_sampler_factory,
+)
+
+__all__ = [
+    "DatasetCollectionSampler",
+    "IIDSampler",
+    "RandomLabelIIDSplit",
+    "get_dataset_collection_sampler",
+    "global_sampler_factory",
+]

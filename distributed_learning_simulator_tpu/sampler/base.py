@@ -1,0 +1,145 @@
+"""Dataset partitioning over federated participants.
+
+TPU-native equivalent of the reference's sampler layer
+(``simulation_lib/sampler/base.py:9-46`` + the toolbox
+``get_dataset_collection_sampler``/``global_sampler_factory`` surface).
+A sampler assigns each of ``part_number`` participants an index set per
+phase; partitions are deterministic in the config seed.
+"""
+
+from collections.abc import Callable
+
+import numpy as np
+
+from ..data.collection import DatasetCollection
+from ..ml_type import MachineLearningPhase as Phase
+
+global_sampler_factory: dict[str, Callable[..., "DatasetCollectionSampler"]] = {}
+
+
+def register_sampler(name: str):
+    def deco(cls):
+        global_sampler_factory[name.lower()] = cls
+        return cls
+
+    return deco
+
+
+class DatasetCollectionSampler:
+    """Base: computes per-part index arrays for every phase once."""
+
+    def __init__(
+        self,
+        dataset_collection: DatasetCollection,
+        part_number: int,
+        seed: int = 0,
+        **kwargs,
+    ) -> None:
+        self.dataset_collection = dataset_collection
+        self.part_number = part_number
+        self.seed = seed
+        self._parts: dict[int, dict[Phase, np.ndarray]] = {
+            i: {} for i in range(part_number)
+        }
+        if dataset_collection.dataset_type == "graph":
+            # one label-stratified NODE partition shared by every phase, so a
+            # worker owns a consistent subgraph (per-phase masks intersect at
+            # subset time)
+            dataset = next(iter(dataset_collection.datasets.values()))
+            split = self._split_indices(
+                np.arange(len(dataset.targets)), dataset.targets, Phase.Training
+            )
+            for i, idx in enumerate(split):
+                for phase in dataset_collection.datasets:
+                    self._parts[i][phase] = np.sort(idx)
+            return
+        for phase in list(dataset_collection.datasets):
+            dataset = dataset_collection.get_dataset(phase)
+            split = self._split_indices(
+                np.arange(len(dataset)), dataset.targets, phase
+            )
+            for i, idx in enumerate(split):
+                self._parts[i][phase] = np.sort(idx)
+
+    # subclass hook
+    def _split_indices(
+        self, indices: np.ndarray, targets: np.ndarray, phase: Phase
+    ) -> list[np.ndarray]:
+        raise NotImplementedError
+
+    def sample(self, part_id: int) -> dict[Phase, np.ndarray]:
+        return self._parts[part_id]
+
+    def sample_dataset(self, part_id: int) -> DatasetCollection:
+        return self.dataset_collection.subset(self._parts[part_id])
+
+
+def _phase_salt(phase: Phase) -> int:
+    """Stable per-phase RNG salt (``hash()`` of an enum is PYTHONHASHSEED-
+    randomized per process and would break cross-run determinism)."""
+    return list(Phase).index(phase) + 1
+
+
+@register_sampler("iid")
+class IIDSampler(DatasetCollectionSampler):
+    """Per-class proportional split: each part receives an equal IID share of
+    every class (reference default ``dataset_sampling: iid``)."""
+
+    def _split_indices(self, indices, targets, phase):
+        rng = np.random.default_rng(self.seed * 1009 + _phase_salt(phase))
+        parts: list[list[np.ndarray]] = [[] for _ in range(self.part_number)]
+        for label in np.unique(targets):
+            label_idx = indices[targets == label]
+            label_idx = rng.permutation(label_idx)
+            for i, chunk in enumerate(np.array_split(label_idx, self.part_number)):
+                parts[i].append(chunk)
+        return [np.concatenate(p) if p else np.array([], dtype=np.int64) for p in parts]
+
+
+@register_sampler("random_label_iid")
+class RandomLabelIIDSplit(DatasetCollectionSampler):
+    """Non-IID: each part draws ``sampled_class_number`` random classes (all
+    classes covered overall), then per-class IID sharding among the parts that
+    hold the class (reference ``simulation_lib/sampler/base.py:9-46``)."""
+
+    def __init__(self, dataset_collection, part_number, sampled_class_number=None, **kwargs):
+        num_classes = dataset_collection.num_classes
+        if sampled_class_number is None:
+            sampled_class_number = max(1, num_classes // 2)
+        assert sampled_class_number <= num_classes
+        rng = np.random.default_rng(kwargs.get("seed", 0) + 17)
+        while True:
+            assignment = [
+                set(rng.choice(num_classes, size=sampled_class_number, replace=False))
+                for _ in range(part_number)
+            ]
+            covered = set().union(*assignment)
+            if len(covered) == num_classes or part_number * sampled_class_number < num_classes:
+                break
+        self._assignment = assignment
+        super().__init__(dataset_collection, part_number, **kwargs)
+
+    def _split_indices(self, indices, targets, phase):
+        if phase is not Phase.Training:
+            # evaluation phases stay IID so every worker can validate
+            rng = np.random.default_rng(self.seed + 23)
+            return list(np.array_split(rng.permutation(indices), self.part_number))
+        rng = np.random.default_rng(self.seed * 1009 + _phase_salt(phase))
+        parts: list[list[np.ndarray]] = [[] for _ in range(self.part_number)]
+        for label in np.unique(targets):
+            holders = [i for i, classes in enumerate(self._assignment) if label in classes]
+            if not holders:
+                holders = list(range(self.part_number))
+            label_idx = rng.permutation(indices[targets == label])
+            for holder, chunk in zip(holders, np.array_split(label_idx, len(holders))):
+                parts[holder].append(chunk)
+        return [np.concatenate(p) if p else np.array([], dtype=np.int64) for p in parts]
+
+
+def get_dataset_collection_sampler(
+    name: str, dataset_collection: DatasetCollection, part_number: int, **kwargs
+) -> DatasetCollectionSampler:
+    cls = global_sampler_factory.get(name.lower())
+    if cls is None:
+        raise KeyError(f"unknown sampler {name!r}; known: {sorted(global_sampler_factory)}")
+    return cls(dataset_collection, part_number, **kwargs)

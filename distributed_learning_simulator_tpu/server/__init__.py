@@ -1,0 +1,4 @@
+from .server import Server
+from .aggregation_server import AggregationServer
+
+__all__ = ["Server", "AggregationServer"]

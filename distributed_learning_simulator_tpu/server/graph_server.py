@@ -1,0 +1,12 @@
+"""Graph-FL server (reference ``simulation_lib/server/graph_server.py:5-7``)."""
+
+from typing import Any
+
+from ..algorithm.graph_algorithm import GraphNodeEmbeddingPassingAlgorithm
+from .aggregation_server import AggregationServer
+
+
+class GraphNodeServer(AggregationServer):
+    def __init__(self, **kwargs: Any) -> None:
+        kwargs.setdefault("algorithm", GraphNodeEmbeddingPassingAlgorithm())
+        super().__init__(**kwargs)

@@ -1,0 +1,166 @@
+"""Server base: the central event loop.
+
+TPU-native equivalent of ``simulation_lib/server/server.py:20-134``: sweep
+workers for pending data, feed ``_process_worker_data``, send results with
+per-worker payloads or selected-subset broadcast (``None`` to unselected),
+own the central test ``Inferencer``.  The gevent 1 s sweep becomes a blocking
+multi-queue wait; evaluation is a jitted XLA program.
+"""
+
+import json
+import os
+import time
+from functools import cached_property
+from typing import Any
+
+from ..engine.executor import Inferencer
+from ..executor import Executor
+from ..message import Message, ParameterMessage
+from ..ml_type import MachineLearningPhase
+from ..ops.pytree import Params
+from ..utils.logging import get_logger
+
+
+class Server(Executor):
+    def __init__(self, task_id: int | None, endpoint, config=None, task_context=None, **kwargs: Any) -> None:
+        name = "server"
+        if task_id is not None:
+            name = f"server of {task_id}"
+        super().__init__(config=config, name=name, task_context=task_context)
+        self._endpoint = endpoint
+
+    @property
+    def worker_number(self) -> int:
+        return self.config.worker_number
+
+    @cached_property
+    def tester(self) -> Inferencer:
+        return Inferencer(
+            self.config,
+            self._task_context.dataset_collection,
+            self._task_context.model_ctx,
+            self._task_context.engine,
+            phase=MachineLearningPhase.Test,
+            seed=self.config.seed,
+            name="tester",
+        )
+
+    def get_metric(
+        self, parameter_dict: Params | ParameterMessage, keep_performance_logger: bool = True
+    ) -> dict:
+        """Load params into the tester and run central inference (reference
+        ``server.py:40-55``)."""
+        if isinstance(parameter_dict, ParameterMessage):
+            parameter_dict = parameter_dict.parameter
+        self.tester.load_parameter_dict(parameter_dict)
+        metric = self.tester.inference()
+        if keep_performance_logger:
+            get_logger().info(
+                "%s test accuracy %.4f loss %.4f",
+                self.tester.visualizer_prefix,
+                metric["accuracy"],
+                metric["loss"],
+            )
+        return metric
+
+    def start(self) -> None:
+        with self._get_execution_context():
+            os.makedirs(self.save_dir, exist_ok=True)
+            with open(
+                os.path.join(self.save_dir, "config.json"), "wt", encoding="utf8"
+            ) as f:
+                json.dump(
+                    {k: v for k, v in vars(self.config).items() if _is_jsonable(v)},
+                    f,
+                    default=str,
+                )
+            self._before_start()
+
+            worker_set: set[int] = set()
+            while not self._stopped():
+                if not worker_set:
+                    worker_set = self._active_workers()
+                progressed = False
+                for worker_id in sorted(worker_set):
+                    if self._endpoint.has_data(worker_id):
+                        self._process_worker_data(
+                            worker_id, self._endpoint.get(worker_id)
+                        )
+                        worker_set.remove(worker_id)
+                        progressed = True
+                if self._task_context is not None and self._task_context.aborted():
+                    break
+                if not progressed and worker_set and not self._stopped():
+                    _wait_any(self._endpoint, worker_set)
+            self._endpoint.close()
+            self._server_exit()
+            get_logger().debug("end server")
+
+    def _before_start(self) -> None:
+        pass
+
+    def _server_exit(self) -> None:
+        pass
+
+    def _process_worker_data(self, worker_id: int, data: Message | None) -> None:
+        raise NotImplementedError
+
+    def _before_send_result(self, result: Message) -> None:
+        pass
+
+    def _after_send_result(self, result: Message) -> None:
+        pass
+
+    def _send_result(self, result: Message) -> None:
+        self._before_send_result(result=result)
+        if "worker_result" in result.other_data:
+            for worker_id, data in result.other_data["worker_result"].items():
+                self._endpoint.send(worker_id=worker_id, data=data)
+        else:
+            selected_workers = self._select_workers()
+            get_logger().debug("choose workers %s", selected_workers)
+            if selected_workers:
+                self._endpoint.broadcast(data=result, worker_ids=selected_workers)
+            unselected = set(range(self.worker_number)) - selected_workers
+            if unselected:
+                self._endpoint.broadcast(data=None, worker_ids=unselected)
+        self._after_send_result(result=result)
+
+    def _active_workers(self) -> set[int]:
+        """Workers the event loop still expects messages from (subclasses
+        shrink this as workers finish — per-step gradient methods)."""
+        return set(range(self._endpoint.worker_num))
+
+    def _select_workers(self) -> set[int]:
+        """Random client selection (reference ``server.py:123-131``),
+        deterministic in (seed, round)."""
+        from ..utils.selection import select_workers
+
+        return select_workers(
+            self.config.seed,
+            getattr(self, "_round_number", 0),
+            self.worker_number,
+            self.config.algorithm_kwargs.get("random_client_number"),
+        )
+
+    def _stopped(self) -> bool:
+        raise NotImplementedError
+
+
+def _wait_any(endpoint, worker_set: set[int], timeout: float = 0.5) -> None:
+    """Block until some worker has data (replaces the reference's 1 s gevent
+    sleep-poll, ``server.py:85``) via the topology's wakeup event."""
+    wakeup = getattr(getattr(endpoint, "_topology", None), "server_wakeup", None)
+    if wakeup is None:
+        time.sleep(0.05)
+        return
+    wakeup.wait(timeout=timeout)
+    wakeup.clear()
+
+
+def _is_jsonable(value: Any) -> bool:
+    try:
+        json.dumps(value)
+        return True
+    except (TypeError, ValueError):
+        return False

@@ -1,0 +1,4 @@
+from .gtg_shapley_value import GTGShapleyValue
+from .multiround_shapley_value import MultiRoundShapleyValue
+
+__all__ = ["GTGShapleyValue", "MultiRoundShapleyValue"]

@@ -1,0 +1,55 @@
+"""Shapley-value engine base.
+
+TPU-native equivalents of the reference's external SV engines
+(``cyy_torch_algorithm.shapely_value``; surface per SURVEY.md §2.13: ctor
+``(players, last_round_metric)``, ``set_metric_function(cb)``,
+``compute(round_number)``, ``.shapley_values``, ``.shapley_values_S``).  The
+metric callback re-aggregates a player subset and runs central inference —
+the framework batches those evals through one jitted eval program; the
+engine itself is pure host logic with per-round subset-metric caching.
+"""
+
+from collections.abc import Callable, Iterable
+
+
+class ShapleyValueEngine:
+    def __init__(self, players: Iterable, last_round_metric: float = 0.0) -> None:
+        self.players: list = sorted(players)
+        self.last_round_metric = float(last_round_metric)
+        self.metric_fn: Callable[[Iterable], float] | None = None
+        # round -> {player: sv}
+        self.shapley_values: dict[int, dict] = {}
+        # round -> {player: sv} restricted to the best-metric subset
+        self.shapley_values_S: dict[int, dict] = {}
+        self._cache: dict[frozenset, float] = {}
+
+    def set_metric_function(self, fn: Callable[[Iterable], float]) -> None:
+        self.metric_fn = fn
+
+    def _metric(self, subset: Iterable) -> float:
+        key = frozenset(subset)
+        if not key:
+            return self.last_round_metric
+        if key not in self._cache:
+            assert self.metric_fn is not None
+            self._cache[key] = float(self.metric_fn(tuple(sorted(key))))
+        return self._cache[key]
+
+    def _best_subset(self) -> frozenset:
+        if not self._cache:
+            return frozenset()
+        return max(self._cache, key=self._cache.get)
+
+    def compute(self, round_number: int) -> None:
+        raise NotImplementedError
+
+    def _finish_round(self, round_number: int, sv: dict) -> None:
+        self.shapley_values[round_number] = dict(sv)
+        best = self._best_subset()
+        self.shapley_values_S[round_number] = {
+            player: sv.get(player, 0.0) for player in sorted(best)
+        }
+        full_metric = self._cache.get(frozenset(self.players))
+        if full_metric is not None:
+            self.last_round_metric = full_metric
+        self._cache.clear()

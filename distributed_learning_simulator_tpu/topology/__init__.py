@@ -1,0 +1,21 @@
+from .central_topology import CentralTopology, ClientEndpoint, ServerEndpoint
+from .quantized_endpoint import (
+    NNADQClientEndpoint,
+    NNADQServerEndpoint,
+    QuantClientEndpoint,
+    QuantServerEndpoint,
+    StochasticQuantClientEndpoint,
+    StochasticQuantServerEndpoint,
+)
+
+__all__ = [
+    "CentralTopology",
+    "ClientEndpoint",
+    "ServerEndpoint",
+    "QuantClientEndpoint",
+    "QuantServerEndpoint",
+    "StochasticQuantClientEndpoint",
+    "StochasticQuantServerEndpoint",
+    "NNADQClientEndpoint",
+    "NNADQServerEndpoint",
+]

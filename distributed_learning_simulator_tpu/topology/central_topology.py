@@ -1,0 +1,105 @@
+"""Hub-and-spoke communication fabric.
+
+TPU-native equivalent of the reference's ``ProcessPipeCentralTopology`` /
+``ClientEndpoint`` / ``ServerEndpoint`` (``cyy_naive_lib.topology``, usage at
+``simulation_lib/server/server.py:66-80`` and ``simulation_lib/worker/client.py:10-22``).
+
+The reference moves pickled tensor dicts through multiprocessing pipes; here
+the control plane is **threads in one process** and an endpoint is a pair of
+``queue.Queue``s — message handoff is by reference, parameter payloads stay
+device-resident, and the actual heavy data movement happens inside XLA
+programs (collectives over ICI on a real mesh).
+"""
+
+import queue
+import threading
+from typing import Any
+
+
+class _Channel:
+    """One direction of a link."""
+
+    def __init__(self, notify: threading.Event | None = None) -> None:
+        self._queue: queue.Queue = queue.Queue()
+        self._notify = notify
+
+    def put(self, item: Any) -> None:
+        self._queue.put(item)
+        if self._notify is not None:
+            self._notify.set()
+
+    def get(self, timeout: float | None = None) -> Any:
+        return self._queue.get(timeout=timeout)
+
+    def has_data(self) -> bool:
+        return not self._queue.empty()
+
+
+class CentralTopology:
+    """Server ↔ each-of-N-workers star (reference ``ProcessPipeCentralTopology``)."""
+
+    def __init__(self, worker_num: int) -> None:
+        self.worker_num = worker_num
+        # any worker→server put sets this; the server's event loop blocks on
+        # it instead of sleep-polling every pipe like the reference
+        self.server_wakeup = threading.Event()
+        self._to_server = {
+            w: _Channel(notify=self.server_wakeup) for w in range(worker_num)
+        }
+        self._to_worker = {w: _Channel() for w in range(worker_num)}
+        self._closed = threading.Event()
+
+    def create_client_endpoint(self, worker_id: int) -> "ClientEndpoint":
+        return ClientEndpoint(self, worker_id)
+
+    def create_server_endpoint(self) -> "ServerEndpoint":
+        return ServerEndpoint(self)
+
+
+class ClientEndpoint:
+    """Worker-side endpoint (reference surface: send/get/has_data/close)."""
+
+    def __init__(self, topology: CentralTopology, worker_id: int) -> None:
+        self._topology = topology
+        self.worker_id = worker_id
+
+    def send(self, data: Any) -> None:
+        self._topology._to_server[self.worker_id].put(data)
+
+    def get(self, timeout: float | None = None) -> Any:
+        return self._topology._to_worker[self.worker_id].get(timeout=timeout)
+
+    def has_data(self) -> bool:
+        return self._topology._to_worker[self.worker_id].has_data()
+
+    def close(self) -> None:
+        pass
+
+
+class ServerEndpoint:
+    """Server-side endpoint (reference surface: per-worker get/send/has_data,
+    broadcast, close)."""
+
+    def __init__(self, topology: CentralTopology) -> None:
+        self._topology = topology
+
+    @property
+    def worker_num(self) -> int:
+        return self._topology.worker_num
+
+    def has_data(self, worker_id: int) -> bool:
+        return self._topology._to_server[worker_id].has_data()
+
+    def get(self, worker_id: int, timeout: float | None = None) -> Any:
+        return self._topology._to_server[worker_id].get(timeout=timeout)
+
+    def send(self, worker_id: int, data: Any) -> None:
+        self._topology._to_worker[worker_id].put(data)
+
+    def broadcast(self, data: Any, worker_ids: set[int] | None = None) -> None:
+        for worker_id in range(self.worker_num):
+            if worker_ids is None or worker_id in worker_ids:
+                self.send(worker_id, data)
+
+    def close(self) -> None:
+        pass

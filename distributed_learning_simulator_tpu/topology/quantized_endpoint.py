@@ -1,0 +1,183 @@
+"""Quantized endpoint decorators.
+
+TPU-native equivalent of ``simulation_lib/topology/quantized_endpoint.py:14-116``:
+endpoints that compress parameter payloads on ``send``/``broadcast`` and
+decompress on ``get``.  The codecs are the jitted pytree transforms in
+``ops/quantization.py``; compression ratios are logged like the reference's
+``_after_quant`` / ``check_compression_ratio`` hooks (scraped downstream by
+``analysis/analyze_log.py``).
+"""
+
+import dataclasses
+from typing import Any
+
+from ..message import DeltaParameterMessage, Message, ParameterMessage
+from ..ops.quantization import NNADQ, check_compression_ratio, stochastic_quantization
+from ..utils.logging import get_logger
+from .central_topology import ClientEndpoint, ServerEndpoint
+
+
+def _payload_field(message: Any) -> str | None:
+    if isinstance(message, ParameterMessage):
+        return "parameter"
+    if isinstance(message, DeltaParameterMessage):
+        return "delta_parameter"
+    return None
+
+
+class _QuantCodecMixin:
+    """quantize on the way out, dequantize on the way in."""
+
+    def _init_codec(self, name: str) -> None:
+        self._codec_name = name
+        self._quant_seed = 0
+        self.compression_ratios: list[float] = []
+
+    def _quant(self, tree):  # subclass hook
+        raise NotImplementedError
+
+    def _dequant(self, blob):  # subclass hook
+        raise NotImplementedError
+
+    def _after_quant(self, original, encoded) -> None:
+        ratio = check_compression_ratio(original, encoded)
+        self.compression_ratios.append(ratio)
+        get_logger().info("%s compression ratio: %.6f", self._codec_name, ratio)
+
+    def _encode(self, message: Any) -> Any:
+        field = _payload_field(message)
+        if field is None or getattr(message, "is_initial", False):
+            return message
+        payload = getattr(message, field)
+        encoded = self._quant(payload)
+        self._after_quant(payload, encoded)
+        return dataclasses.replace(message, **{field: _EncodedPayload(encoded)})  # type: ignore[arg-type]
+
+    def _decode(self, message: Any) -> Any:
+        field = _payload_field(message)
+        if field is None:
+            return message
+        payload = getattr(message, field)
+        if isinstance(payload, _EncodedPayload):
+            return dataclasses.replace(message, **{field: self._dequant(payload.blob)})
+        return message
+
+
+class _EncodedPayload:
+    """Wrapper marking a quantized payload travelling through an endpoint."""
+
+    __slots__ = ("blob",)
+
+    def __init__(self, blob: dict) -> None:
+        self.blob = blob
+
+
+class QuantClientEndpoint(_QuantCodecMixin, ClientEndpoint):
+    """Reference ``QuantClientEndpoint`` (``quantized_endpoint.py:14-44``).
+
+    ``dequant_server_data`` gates decoding of quantized server broadcasts
+    (FedOBD turns it on together with the server's ``quant_broadcast``).
+    """
+
+    def __init__(self, topology, worker_id, dequant_server_data: bool = True) -> None:
+        ClientEndpoint.__init__(self, topology, worker_id)
+        self._init_codec(type(self).__name__)
+        self.dequant_server_data = dequant_server_data
+
+    def send(self, data: Any) -> None:
+        if isinstance(data, Message):
+            data = self._encode(data)
+        super().send(data)
+
+    def get(self, timeout: float | None = None) -> Any:
+        data = super().get(timeout=timeout)
+        if isinstance(data, Message) and self.dequant_server_data:
+            data = self._decode(data)
+        return data
+
+
+class QuantServerEndpoint(_QuantCodecMixin, ServerEndpoint):
+    """Reference ``QuantServerEndpoint`` (``quantized_endpoint.py:47-71``):
+    dequantizes worker uploads; optionally quantizes broadcasts
+    (``quant_broadcast``)."""
+
+    def __init__(self, topology, quant_broadcast: bool = False) -> None:
+        ServerEndpoint.__init__(self, topology)
+        self._init_codec(type(self).__name__)
+        self.quant_broadcast = quant_broadcast
+
+    def get(self, worker_id: int, timeout: float | None = None) -> Any:
+        data = super().get(worker_id, timeout=timeout)
+        if isinstance(data, Message):
+            data = self._decode(data)
+        return data
+
+    def send(self, worker_id: int, data: Any) -> None:
+        if self.quant_broadcast and isinstance(data, Message):
+            data = self._encode(data)
+        super().send(worker_id, data)
+
+    def broadcast(self, data: Any, worker_ids: set[int] | None = None) -> None:
+        if self.quant_broadcast and isinstance(data, Message):
+            data = self._encode(data)
+        for worker_id in range(self.worker_num):
+            if worker_ids is None or worker_id in worker_ids:
+                ServerEndpoint.send(self, worker_id, data)
+
+
+class StochasticQuantClientEndpoint(QuantClientEndpoint):
+    """QSGD stochastic quantization, 255 levels (reference
+    ``quantized_endpoint.py:74-78``)."""
+
+    def __init__(self, topology, worker_id, quantization_level: int = 255, **kwargs):
+        super().__init__(topology, worker_id, **kwargs)
+        self._q, self._dq = stochastic_quantization(quantization_level)
+
+    def _quant(self, tree):
+        self._quant_seed += 1
+        return self._q(tree, seed=self._quant_seed * 2 + self.worker_id)
+
+    def _dequant(self, blob):
+        return self._dq(blob)
+
+
+class StochasticQuantServerEndpoint(QuantServerEndpoint):
+    def __init__(self, topology, quantization_level: int = 255, **kwargs):
+        super().__init__(topology, **kwargs)
+        self._q, self._dq = stochastic_quantization(quantization_level)
+
+    def _quant(self, tree):
+        self._quant_seed += 1
+        return self._q(tree, seed=self._quant_seed * 2 + 1)
+
+    def _dequant(self, blob):
+        return self._dq(blob)
+
+
+class NNADQClientEndpoint(QuantClientEndpoint):
+    """Adaptive deterministic quantization with tunable ``weight`` from
+    ``endpoint_kwargs`` (reference ``quantized_endpoint.py:86-101``)."""
+
+    def __init__(self, topology, worker_id, weight: float = 0.01, **kwargs):
+        super().__init__(topology, worker_id, **kwargs)
+        self._codec = NNADQ(weight=weight)
+
+    def _quant(self, tree):
+        return self._codec.quant(tree)
+
+    def _dequant(self, blob):
+        return self._codec.dequant(blob)
+
+
+class NNADQServerEndpoint(QuantServerEndpoint):
+    def __init__(self, topology, weight: float = 0.01, **kwargs):
+        # the reference's FedOBD server quantizes its broadcasts
+        # (method/fed_obd/server.py:14-15)
+        super().__init__(topology, **kwargs)
+        self._codec = NNADQ(weight=weight)
+
+    def _quant(self, tree):
+        return self._codec.quant(tree)
+
+    def _dequant(self, blob):
+        return self._codec.dequant(blob)

@@ -1,0 +1,228 @@
+"""Training orchestration.
+
+TPU-native equivalent of ``simulation_lib/training.py:82-169`` +
+``simulation_lib/algorithm_factory.py:12-61``.  The reference spawns one OS
+process per worker group and a server process wired by multiprocessing
+pipes; here the whole task is a **single-controller** program: one shared
+:class:`ComputeEngine` (one set of compiled XLA executables for all
+clients), the server and workers as host threads exchanging device-resident
+payloads through in-memory endpoints.  Concurrent tasks keep the reference's
+``task_id`` / ``get_training_result`` API.
+"""
+
+import copy
+import dataclasses
+import math
+import threading
+import uuid
+from typing import Any
+
+from .config import DistributedTrainingConfig
+from .data import DatasetCollection, create_dataset_collection
+from .engine.engine import ComputeEngine
+from .engine.hyper_parameter import HyperParameter
+from .method.algorithm_factory import CentralizedAlgorithmFactory
+from .ml_type import TaskAbortedError
+from .models import ModelContext, create_model_context
+from .practitioner import Practitioner
+from .topology.central_topology import CentralTopology
+from .utils.logging import add_file_handler, get_logger
+from .utils.timer import TimeCounter
+
+
+@dataclasses.dataclass
+class TaskContext:
+    """Shared, read-only task state: one engine/model/dataset for all
+    executors (the reference rebuilt these per process)."""
+
+    config: DistributedTrainingConfig
+    dataset_collection: DatasetCollection
+    model_ctx: ModelContext
+    engine: ComputeEngine
+    topology: CentralTopology
+    task_id: Any
+    abort_event: threading.Event = dataclasses.field(default_factory=threading.Event)
+    threads: list = dataclasses.field(default_factory=list)
+    errors: list = dataclasses.field(default_factory=list)
+    server: Any = None
+    workers: list = dataclasses.field(default_factory=list)
+    practitioners: list = dataclasses.field(default_factory=list)
+    timer: TimeCounter = dataclasses.field(default_factory=TimeCounter)
+
+    def aborted(self) -> bool:
+        return self.abort_event.is_set()
+
+
+tasks: dict[Any, TaskContext] = {}
+_tasks_lock = threading.Lock()
+
+
+def _build_task(
+    config: DistributedTrainingConfig,
+    practitioners=None,
+    task_id=None,
+) -> TaskContext:
+    config = copy.deepcopy(config)
+    if not config.save_dir:
+        config.load_config_and_process()
+    if config.log_file:
+        add_file_handler(config.log_file)
+    algorithm = config.distributed_algorithm
+    assert CentralizedAlgorithmFactory.has_algorithm(
+        algorithm
+    ), f"unknown distributed algorithm {algorithm}"
+
+    if practitioners is None:
+        practitioners = config.create_practitioners()
+    else:
+        for worker_id, practitioner in enumerate(
+            sorted(practitioners, key=lambda p: p.practitioner_id)
+        ):
+            assert practitioner.has_dataset(config.dataset_name)
+            practitioner.set_worker_id(worker_id)
+    practitioners = sorted(practitioners, key=lambda p: p.worker_id)
+    assert len(practitioners) == config.worker_number
+
+    dataset_collection = create_dataset_collection(config)
+    model_ctx = create_model_context(
+        config.model_name, dataset_collection, **dict(config.model_kwargs)
+    )
+    hyper_parameter = HyperParameter.from_config(config)
+    from .ml_type import MachineLearningPhase as Phase
+
+    train_size = dataset_collection.dataset_size(Phase.Training)
+    steps_per_epoch = max(
+        1, math.ceil(train_size / config.worker_number / config.batch_size)
+    )
+    engine = ComputeEngine(
+        model_ctx, hyper_parameter, total_steps=steps_per_epoch * config.epoch
+    )
+    topology = CentralTopology(config.worker_number)
+    return TaskContext(
+        config=config,
+        dataset_collection=dataset_collection,
+        model_ctx=model_ctx,
+        engine=engine,
+        topology=topology,
+        task_id=task_id,
+        practitioners=practitioners,
+    )
+
+
+def _spawn(ctx: TaskContext) -> None:
+    config = ctx.config
+    algorithm = config.distributed_algorithm
+    common = {"config": config, "task_context": ctx, "task_id": ctx.task_id}
+    ctx.server = CentralizedAlgorithmFactory.create_server(
+        algorithm,
+        ctx.topology,
+        endpoint_kwargs=config.endpoint_kwargs.get("server", {}),
+        kwargs=dict(common),
+    )
+    for practitioner in ctx.practitioners:
+        worker = CentralizedAlgorithmFactory.create_client(
+            algorithm,
+            ctx.topology,
+            worker_id=practitioner.worker_id,
+            endpoint_kwargs=config.endpoint_kwargs.get("worker", {}),
+            kwargs={**common, "practitioner": practitioner},
+        )
+        ctx.workers.append(worker)
+
+    def run(executor) -> None:
+        try:
+            executor.start()
+        except TaskAbortedError:
+            get_logger().debug("%s aborted", executor.name)
+        except Exception as exc:  # noqa: BLE001 — propagate to the caller
+            get_logger().exception("%s failed", executor.name)
+            ctx.errors.append(exc)
+            ctx.abort_event.set()
+
+    for executor in [ctx.server, *ctx.workers]:
+        thread = threading.Thread(
+            target=run, args=(executor,), name=executor.name, daemon=True
+        )
+        ctx.threads.append(thread)
+    for thread in ctx.threads:
+        thread.start()
+
+
+def _harvest(ctx: TaskContext) -> dict:
+    for thread in ctx.threads:
+        thread.join()
+    if ctx.errors:
+        raise ctx.errors[0]
+    get_logger().info(
+        "training took %.2f seconds", ctx.timer.elapsed_seconds()
+    )
+    result: dict = {"performance": ctx.server.performance_stat}
+    sv = getattr(getattr(ctx.server, "algorithm", None), "shapley_values", None)
+    if sv:
+        # remap worker ids back to practitioner ids (reference
+        # ``get_training_result``, training.py:156-167)
+        worker_to_practitioner = {
+            p.worker_id: p.practitioner_id for p in ctx.practitioners
+        }
+        result["sv"] = {
+            round_number: {
+                worker_to_practitioner[w]: value for w, value in round_sv.items()
+            }
+            for round_number, round_sv in sv.items()
+        }
+    return result
+
+
+def train(
+    config: DistributedTrainingConfig,
+    practitioners=None,
+    return_task_id: bool = False,
+    **kwargs: Any,
+) -> dict | Any:
+    """Run one federated training task (reference ``train``,
+    ``training.py:82-137``).  With ``return_task_id`` the task runs in the
+    background; fetch results with :func:`get_training_result`."""
+    task_id = uuid.uuid4() if return_task_id else None
+    ctx = _build_task(config, practitioners=practitioners, task_id=task_id)
+    if ctx.config.executor == "spmd":
+        assert (
+            ctx.config.distributed_algorithm == "fed_avg"
+        ), "the SPMD fast path currently implements the fed_avg round program"
+        from .parallel.spmd import SpmdFedAvgSession
+
+        session = SpmdFedAvgSession(
+            ctx.config,
+            ctx.dataset_collection,
+            ctx.model_ctx,
+            ctx.engine,
+            ctx.practitioners,
+        )
+        result = session.run()
+        get_logger().info("training took %.2f seconds", ctx.timer.elapsed_seconds())
+        if return_task_id:
+            raise NotImplementedError("spmd executor is synchronous")
+        return result
+    _spawn(ctx)
+    if return_task_id:
+        with _tasks_lock:
+            tasks[task_id] = ctx
+        return task_id
+    return _harvest(ctx)
+
+
+def get_training_result(task_id: Any, timeout: float | None = None) -> dict:
+    """Wait for a background task and return its results (reference
+    ``get_training_result``, ``training.py:140-169``).  On timeout the task
+    stays registered so the caller can retry."""
+    with _tasks_lock:
+        ctx = tasks[task_id]
+    if timeout is not None:
+        deadline = ctx.timer.elapsed_seconds() + timeout
+        for thread in ctx.threads:
+            remaining = deadline - ctx.timer.elapsed_seconds()
+            thread.join(timeout=max(0.0, remaining))
+        if any(thread.is_alive() for thread in ctx.threads):
+            raise TimeoutError(f"task {task_id} still running")
+    with _tasks_lock:
+        tasks.pop(task_id, None)
+    return _harvest(ctx)

@@ -1,0 +1,4 @@
+from .model_cache import ModelCache
+from .model import load_parameters
+
+__all__ = ["ModelCache", "load_parameters"]

@@ -1,0 +1,11 @@
+"""Parameter loading helper (reference ``simulation_lib/util/model.py:6-23``)."""
+
+from ..ops.pytree import Params
+
+
+def load_parameters(trainer, parameter_dict: Params, reuse_learning_rate: bool) -> None:
+    """Load a global parameter dict into a trainer.  ``reuse_learning_rate``
+    keeps the optimizer state (lr/momentum) across the load — FedOBD phase 2
+    semantics.  Running-stats disabling is structural here: norms are
+    stateless (GroupNorm/LayerNorm), see ``models/vision.py``."""
+    trainer.load_parameter_dict(parameter_dict, reuse_learning_rate=reuse_learning_rate)

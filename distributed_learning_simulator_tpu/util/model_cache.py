@@ -1,0 +1,68 @@
+"""Global-model mirror with disk spillover.
+
+TPU-native equivalent of ``simulation_lib/util/model_cache.py:10-51``
+(``ModelCache`` over ``cyy_naive_lib.storage.DataStorage``): keeps the last
+distributed global parameters, computes/applies diffs, and can spill to disk
+(``.npz``) under ``limited_resource``.
+"""
+
+import os
+
+import jax
+
+from ..ops.pytree import Params, params_add, params_diff
+
+
+class ModelCache:
+    def __init__(self) -> None:
+        self._parameter_dict: Params | None = None
+        self._path: str | None = None
+        self._dirty = False
+
+    @property
+    def has_data(self) -> bool:
+        return self._parameter_dict is not None or (
+            self._path is not None and os.path.isfile(self._path)
+        )
+
+    @property
+    def parameter_dict(self) -> Params | None:
+        if self._parameter_dict is None and self._path and os.path.isfile(self._path):
+            import numpy as np
+
+            blob = np.load(self._path)
+            self._parameter_dict = {k: blob[k] for k in blob.files}
+        return self._parameter_dict
+
+    def cache_parameter_dict(self, parameter_dict: Params, path: str | None = None) -> None:
+        self._parameter_dict = dict(parameter_dict)
+        if path is not None:
+            self._path = path
+        self._dirty = True
+
+    def get_parameter_diff(self, new_parameter: Params) -> Params:
+        assert self.parameter_dict is not None
+        return params_diff(new_parameter, self.parameter_dict)
+
+    def add_parameter_diff(self, parameter_diff: Params, path: str | None = None) -> None:
+        assert self.parameter_dict is not None
+        self.cache_parameter_dict(
+            params_add(self.parameter_dict, parameter_diff), path=path
+        )
+
+    def discard(self) -> None:
+        """Drop the in-memory copy (reload lazily from disk)."""
+        if self._path is not None and self._dirty:
+            self.save()
+        self._parameter_dict = None
+
+    def save(self) -> None:
+        if self._path is None or self._parameter_dict is None:
+            return
+        import numpy as np
+
+        os.makedirs(os.path.dirname(os.path.abspath(self._path)), exist_ok=True)
+        np.savez(
+            self._path, **{k: np.asarray(v) for k, v in self._parameter_dict.items()}
+        )
+        self._dirty = False

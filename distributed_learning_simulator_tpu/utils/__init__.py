@@ -1,0 +1,4 @@
+from .logging import add_file_handler, get_logger
+from .timer import TimeCounter
+
+__all__ = ["get_logger", "add_file_handler", "TimeCounter"]

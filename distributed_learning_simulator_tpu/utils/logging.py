@@ -1,0 +1,74 @@
+"""Logging for the framework.
+
+TPU-native stand-in for ``cyy_naive_lib.log`` (used across ~20 reference files,
+e.g. ``simulation_lib/training.py``): one process-wide logger with colored
+console output and optional per-run file handlers.
+"""
+
+import logging
+import os
+import sys
+import threading
+
+_LOGGER_NAME = "dls_tpu"
+_lock = threading.Lock()
+_file_handlers: dict[str, logging.FileHandler] = {}
+
+
+class _ColorFormatter(logging.Formatter):
+    COLORS = {
+        logging.DEBUG: "\x1b[36m",
+        logging.INFO: "\x1b[32m",
+        logging.WARNING: "\x1b[33m",
+        logging.ERROR: "\x1b[31m",
+        logging.CRITICAL: "\x1b[41m",
+    }
+    RESET = "\x1b[0m"
+
+    def format(self, record: logging.LogRecord) -> str:
+        msg = super().format(record)
+        if sys.stderr.isatty():
+            color = self.COLORS.get(record.levelno, "")
+            return f"{color}{msg}{self.RESET}"
+        return msg
+
+
+_FMT = "%(asctime)s %(levelname)s {%(processName)s} [%(filename)s:%(lineno)d] %(message)s"
+
+
+def get_logger() -> logging.Logger:
+    logger = logging.getLogger(_LOGGER_NAME)
+    with _lock:
+        if not logger.handlers:
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(_ColorFormatter(_FMT, datefmt="%H:%M:%S"))
+            logger.addHandler(handler)
+            logger.setLevel(logging.INFO)
+            logger.propagate = False
+    return logger
+
+
+def set_level(level: str | int) -> None:
+    get_logger().setLevel(level)
+
+
+def add_file_handler(path: str) -> None:
+    """Attach a per-run log file (reference: ``add_file_handler(config.log_file)``)."""
+    logger = get_logger()
+    with _lock:
+        if path in _file_handlers:
+            return
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        handler = logging.FileHandler(path)
+        handler.setFormatter(logging.Formatter(_FMT, datefmt="%Y-%m-%d %H:%M:%S"))
+        logger.addHandler(handler)
+        _file_handlers[path] = handler
+
+
+def remove_file_handler(path: str) -> None:
+    logger = get_logger()
+    with _lock:
+        handler = _file_handlers.pop(path, None)
+        if handler is not None:
+            logger.removeHandler(handler)
+            handler.close()

@@ -1,0 +1,17 @@
+"""Client selection, deterministic in (seed, round).
+
+One shared implementation so the threaded server (``server/server.py``) and
+the SPMD fast path (``parallel/spmd.py``) pick identical client subsets for
+identical configs (reference selection: ``server/server.py:123-131``).
+"""
+
+import random
+
+
+def select_workers(
+    seed: int, round_number: int, worker_number: int, k: int | None
+) -> set[int]:
+    if k is None or k >= worker_number:
+        return set(range(worker_number))
+    rng = random.Random(seed * 1_000_003 + round_number)
+    return set(rng.sample(range(worker_number), k=k))

@@ -1,0 +1,18 @@
+"""Wall-clock timing (reference: ``cyy_naive_lib.time_counter.TimeCounter``,
+used at ``simulation_lib/training.py:88,136``)."""
+
+import time
+
+
+class TimeCounter:
+    def __init__(self) -> None:
+        self._start = time.monotonic()
+
+    def reset_start_time(self) -> None:
+        self._start = time.monotonic()
+
+    def elapsed_seconds(self) -> float:
+        return time.monotonic() - self._start
+
+    def elapsed_milliseconds(self) -> float:
+        return self.elapsed_seconds() * 1000.0

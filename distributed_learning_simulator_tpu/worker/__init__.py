@@ -1,0 +1,13 @@
+from .worker import Worker
+from .client import Client
+from .aggregation_worker import AggregationWorker
+from .error_feedback_worker import ErrorFeedbackWorker
+from .gradient_worker import GradientWorker
+
+__all__ = [
+    "Worker",
+    "Client",
+    "AggregationWorker",
+    "ErrorFeedbackWorker",
+    "GradientWorker",
+]

@@ -1,0 +1,184 @@
+"""The FedAvg client.
+
+TPU-native equivalent of
+``simulation_lib/worker/aggregation_worker.py:16-144``: registers an
+aggregation hook at a configurable hook point (AFTER_EXECUTE by default),
+sends parameter deltas (or full params / best-validation params), blocks for
+the aggregated result, handles unselected-round ``None``s and
+``end_training``, and mirrors the global model in a :class:`ModelCache`.
+"""
+
+import os
+from typing import Any
+
+import jax
+
+from ..engine.batching import make_epoch_batches
+from ..engine.engine import summarize_metrics
+from ..message import (
+    DeltaParameterMessage,
+    Message,
+    ParameterMessage,
+    ParameterMessageBase,
+)
+from ..ml_type import ExecutorHookPoint, MachineLearningPhase, StopExecutingException
+from ..util.model import load_parameters
+from ..util.model_cache import ModelCache
+from ..utils.logging import get_logger
+from .client import Client
+
+
+class KeepModelHook:
+    """Keep the best params by validation accuracy across the round's epochs
+    (reference ``cyy_torch_toolbox.hook.keep_model.KeepModelHook``)."""
+
+    def __init__(self, trainer) -> None:
+        self._trainer = trainer
+        self.keep_best_model = True
+        self.best_model: dict[str, Any] | None = None
+
+    def __call__(self, executor, hook_point, **kwargs) -> None:
+        trainer = executor
+        dc = trainer.dataset_collection
+        if not dc.has_dataset(MachineLearningPhase.Validation):
+            return
+        batches = trainer._epoch_batches(MachineLearningPhase.Validation, None)
+        metrics = summarize_metrics(trainer.engine.evaluate(trainer.params, batches))
+        if self.best_model is None or metrics["accuracy"] >= self.best_model["accuracy"]:
+            self.best_model = {
+                "parameter": dict(trainer.params),
+                "accuracy": metrics["accuracy"],
+            }
+
+    def clear(self) -> None:
+        self.best_model = None
+
+
+class AggregationWorker(Client):
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._aggregation_time: ExecutorHookPoint = ExecutorHookPoint.AFTER_EXECUTE
+        self._reuse_learning_rate: bool = False
+        self._choose_model_by_validation: bool = False
+        self._send_parameter_diff: bool = True
+        self._model_cache: ModelCache = ModelCache()
+        self._keep_model_hook: KeepModelHook | None = None
+
+    def _before_training(self) -> None:
+        super()._before_training()
+        dc = self.trainer.dataset_collection
+        dc.remove_dataset(phase=MachineLearningPhase.Test)
+        if self.config.dataset_sampling == "iid":
+            self.enable_choose_model_by_validation()
+        if not self._choose_model_by_validation:
+            dc.remove_dataset(phase=MachineLearningPhase.Validation)
+        if self.config.distribute_init_parameters:
+            self._get_result_from_server()
+            if self._stopped():
+                return
+        self._register_aggregation()
+
+    def _register_aggregation(self) -> None:
+        self.trainer.remove_named_hook(name="aggregation")
+
+        def aggregation_impl(**kwargs) -> None:
+            self._aggregation(sent_data=self._get_sent_data(), **kwargs)
+
+        self.trainer.append_named_hook(
+            self._aggregation_time, "aggregation", aggregation_impl
+        )
+
+    def _aggregation(self, sent_data: Message, **kwargs: Any) -> None:
+        self.send_data_to_server(sent_data)
+        self._offload_from_device()
+        self._get_result_from_server()
+
+    def enable_choose_model_by_validation(self) -> None:
+        dc = self.trainer.dataset_collection
+        if (
+            not dc.has_dataset(MachineLearningPhase.Validation)
+            or dc.dataset_size(MachineLearningPhase.Validation) == 0
+        ):
+            # small splits can leave a worker with no validation samples
+            return
+        self._choose_model_by_validation = True
+        if self._keep_model_hook is None:
+            self._keep_model_hook = KeepModelHook(self.trainer)
+            self.trainer.append_named_hook(
+                ExecutorHookPoint.AFTER_EPOCH, "keep_model_hook", self._keep_model_hook
+            )
+
+    def disable_choose_model_by_validation(self) -> None:
+        self._choose_model_by_validation = False
+        if self._keep_model_hook is not None:
+            self.trainer.remove_named_hook("keep_model_hook")
+            self._keep_model_hook = None
+
+    @property
+    def best_model_hook(self) -> KeepModelHook | None:
+        return self._keep_model_hook
+
+    def _get_sent_data(self) -> ParameterMessageBase:
+        if self._choose_model_by_validation and (
+            self._keep_model_hook is not None
+            and self._keep_model_hook.best_model is not None
+        ):
+            parameter = self._keep_model_hook.best_model["parameter"]
+        else:
+            parameter = self.trainer.get_parameter_dict()
+        if self._send_parameter_diff:
+            return DeltaParameterMessage(
+                dataset_size=self.trainer.dataset_size,
+                delta_parameter=self._model_cache.get_parameter_diff(parameter),
+            )
+        return ParameterMessage(
+            dataset_size=self.trainer.dataset_size, parameter=parameter
+        )
+
+    def _load_result_from_server(self, result: Message) -> None:
+        if result.end_training:
+            self._force_stop = True
+            raise StopExecutingException()
+        if getattr(result, "is_initial", False) and "round" in result.other_data:
+            # server resumed a previous session: jump to its round
+            self._round_num = result.other_data["round"]
+        model_path = os.path.join(
+            self.config.save_dir, "aggregated_model", f"round_{self._round_num}.npz"
+        )
+        match result:
+            case ParameterMessage():
+                self._model_cache.cache_parameter_dict(result.parameter, path=model_path)
+            case DeltaParameterMessage():
+                self._model_cache.add_parameter_diff(
+                    result.delta_parameter, path=model_path
+                )
+            case _:
+                raise NotImplementedError(type(result))
+        load_parameters(
+            trainer=self.trainer,
+            parameter_dict=self._model_cache.parameter_dict,
+            reuse_learning_rate=self._reuse_learning_rate,
+        )
+
+    def _offload_from_device(self) -> None:
+        if self.config.limited_resource:
+            self._model_cache.save()
+        if self._keep_model_hook is not None:
+            self._keep_model_hook.clear()
+        super()._offload_from_device()
+
+    def _get_result_from_server(self) -> None:
+        """Blocking receive; a ``None`` means unselected this round — skip,
+        advance the round, ack with ``None``, and wait again (reference
+        ``aggregation_worker.py:128-144``)."""
+        while True:
+            result = self._get_data_from_server()
+            if result is None:
+                get_logger().debug("%s skips round %s", self.name, self._round_num)
+                self._round_num += 1
+                self.send_data_to_server(None)
+                if self._stopped():
+                    return
+                continue
+            self._load_result_from_server(result=result)
+            break

@@ -1,0 +1,29 @@
+"""Client: a worker wired to the central topology.
+
+TPU-native equivalent of ``simulation_lib/worker/client.py:9-22``.  The
+reference polls ``endpoint.has_data()`` at 0.1 s under gevent while holding
+back the device lock; here the endpoint is a thread-safe queue, so a blocking
+``get`` with a stop-check timeout replaces the poll loop.
+"""
+
+from typing import Any
+
+from .worker import Worker
+
+
+class Client(Worker):
+    def send_data_to_server(self, data: Any) -> None:
+        self._endpoint.send(data)
+
+    def _get_data_from_server(self) -> Any:
+        import queue
+
+        while True:
+            if self._task_context is not None and self._task_context.aborted():
+                from ..ml_type import TaskAbortedError
+
+                raise TaskAbortedError(self.name)
+            try:
+                return self._endpoint.get(timeout=0.5)
+            except queue.Empty:
+                continue

@@ -1,0 +1,36 @@
+"""Compressed-upload worker with error feedback.
+
+TPU-native equivalent of
+``simulation_lib/worker/error_feedback_worker.py:9-19``: keeps a residual
+``_error`` parameter dict, ships ``sparsify(delta + error)`` and folds the
+truncation error back into the residual.  Basis of the ``single_model_afd``
+method family.
+"""
+
+from typing import Any
+
+from ..message import DeltaParameterMessage, ParameterMessageBase
+from ..ops.pytree import Params
+from .aggregation_worker import AggregationWorker
+
+
+class ErrorFeedbackWorker(AggregationWorker):
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        assert self._send_parameter_diff, "error feedback needs diff uploads"
+        self._error: Params | None = None
+
+    def _sparsify(self, delta: Params) -> Params:
+        """Subclass hook: return the (sparse) payload actually sent."""
+        raise NotImplementedError
+
+    def _get_sent_data(self) -> ParameterMessageBase:
+        message = super()._get_sent_data()
+        assert isinstance(message, DeltaParameterMessage)
+        delta = message.delta_parameter
+        if self._error is not None:
+            delta = {k: v + self._error.get(k, 0.0) for k, v in delta.items()}
+        sent = self._sparsify(delta)
+        self._error = {k: delta[k] - sent.get(k, 0.0) for k in delta}
+        message.delta_parameter = sent
+        return message

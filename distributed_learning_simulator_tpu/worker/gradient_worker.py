@@ -1,0 +1,126 @@
+"""Per-optimizer-step distributed SGD worker.
+
+TPU-native equivalent of ``simulation_lib/worker/gradient_worker.py:13-131``:
+hooks OPTIMIZER_STEP, ships the raw (weight-decayed) gradient as one flat
+vector through ``_process_gradient`` (identity here; ``sign`` in the sign-SGD
+subclass), blocks for the aggregated gradient, then applies the
+momentum/nesterov SGD update manually.  Requires the SGD optimizer.
+
+On a real mesh the sign-SGD method family replaces this host round-trip with
+an in-program ``psum`` (see ``parallel/``); this class is the
+simulation-faithful path.
+"""
+
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.engine import summarize_metrics
+from ..message import Message
+from ..ml_type import ExecutorHookPoint
+from ..ops.pytree import cat_params_to_vector, params_from_vector_like
+from ..utils.logging import get_logger
+from .client import Client
+
+
+class GradientWorker(Client):
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        assert self.config.optimizer_name.lower() == "sgd"
+        self._momentum_buffer: jax.Array | None = None
+        self._step_count = 0
+        self._epoch_stat: dict[int, dict] = {}
+
+    def _before_training(self) -> None:
+        super()._before_training()
+        from ..ml_type import MachineLearningPhase
+
+        dc = self.trainer.dataset_collection
+        dc.remove_dataset(phase=MachineLearningPhase.Test)
+        dc.remove_dataset(phase=MachineLearningPhase.Validation)
+        # per-step gradient exchange requires every replica to start from the
+        # same parameters: use the task-level seed, not the per-worker seed
+        self.trainer.load_parameter_dict(
+            self.trainer.engine.init_params(self.config.seed), reuse_learning_rate=False
+        )
+        self.trainer.append_named_hook(
+            ExecutorHookPoint.OPTIMIZER_STEP, "gradient_exchange", self.__step
+        )
+        self.trainer.append_named_hook(
+            ExecutorHookPoint.AFTER_EPOCH, "record_epoch", self.__record
+        )
+        self.trainer.append_named_hook(
+            ExecutorHookPoint.AFTER_EXECUTE, "end_training", self.__send_end
+        )
+
+    # subclass hook (sign() in sign-SGD)
+    def _process_gradient(self, gradient: jax.Array) -> jax.Array:
+        return gradient
+
+    def __step(self, executor, batch, step_rng, **kwargs) -> None:
+        trainer = executor
+        params = trainer.params
+        (loss, aux), grads = trainer.engine.loss_and_grad(params, batch, step_rng)
+        if self.config.weight_decay:
+            grads = {
+                k: g + self.config.weight_decay * params[k] for k, g in grads.items()
+            }
+        vector = cat_params_to_vector(grads)
+        vector = self._process_gradient(vector)
+        self.send_data_to_server(
+            Message(
+                in_round=True,
+                other_data={
+                    "dataset_size": trainer.dataset_size,
+                    "gradient": vector,
+                },
+            )
+        )
+        result = self._get_data_from_server()
+        assert isinstance(result, Message)
+        aggregated = result.other_data["gradient"]
+        params_new, self._momentum_buffer = _sgd_update(
+            params,
+            aggregated,
+            self._momentum_buffer,
+            lr=float(self.trainer.engine.schedule(self._step_count)),
+            momentum=self.config.momentum,
+        )
+        trainer.load_parameter_dict(params_new, reuse_learning_rate=True)
+        self._step_count += 1
+
+    def __record(self, executor, epoch, epoch_metrics, **kwargs) -> None:
+        self._epoch_stat[epoch] = {
+            "loss": epoch_metrics["loss"],
+            "accuracy": epoch_metrics["accuracy"],
+        }
+        with open(
+            os.path.join(self.save_dir, "epoch_stat.json"), "wt", encoding="utf8"
+        ) as f:
+            json.dump(self._epoch_stat, f)
+
+    def __send_end(self, **kwargs) -> None:
+        from ..message import ParameterMessage
+
+        # final params ride along so the server can record the run's test
+        # metric (replicas are identical under lockstep updates)
+        self.send_data_to_server(
+            ParameterMessage(
+                end_training=True,
+                parameter=self.trainer.get_parameter_dict(),
+                dataset_size=self.trainer.dataset_size,
+            )
+        )
+        get_logger().debug("%s sent end_training", self.name)
+
+
+def _sgd_update(params, aggregated_vector, momentum_buffer, lr: float, momentum: float):
+    if momentum_buffer is None:
+        momentum_buffer = jnp.zeros_like(aggregated_vector)
+    momentum_buffer = momentum * momentum_buffer + aggregated_vector
+    delta = params_from_vector_like(momentum_buffer * lr, params)
+    new_params = {k: params[k] - delta[k] for k in params}
+    return new_params, momentum_buffer

@@ -1,0 +1,215 @@
+"""Federated GNN worker (subgraph-per-client).
+
+TPU-native equivalent of ``simulation_lib/worker/graph_worker.py:18-406``:
+
+* before training, exchanges training-node indices through the server
+  (``__exchange_training_node_indices``, reference ``graph_worker.py:68-84``);
+* prunes edges to in-client edges + cross-client *training* edges with
+  optional ``edge_drop_rate`` (reference ``graph_worker.py:197-241``) —
+  pruning here is an **edge mask**, not an edge-list rebuild, so the XLA
+  program keeps static shapes;
+* with ``share_feature``, every training step performs a synchronous
+  boundary-embedding exchange through the server between the first and
+  second message-passing layers (reference installs forward-pre-hooks,
+  ``graph_worker.py:344-373``; here the model's ``embed``/``head`` stages are
+  called explicitly and received rows enter as constants —
+  ``stop_gradient`` — matching the reference's detached pipe tensors);
+* tracks communicated/skipped bytes and edge/node counts, dumped to
+  ``graph_worker_stat.json`` (reference ``graph_worker.py:391-406``).
+"""
+
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..message import Message
+from ..ml_type import ExecutorHookPoint, MachineLearningPhase
+from ..ops.pytree import param_nbytes, unflatten_nested
+from ..utils.logging import get_logger
+from .aggregation_worker import AggregationWorker
+
+
+class GraphWorker(AggregationWorker):
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._share_feature = self.config.algorithm_kwargs.get("share_feature", True)
+        self._edge_drop_rate = self.config.algorithm_kwargs.get("edge_drop_rate", 0.0)
+        self._send_parameter_diff = False
+        self._other_training_node_indices: set[int] = set()
+        self._own_nodes: np.ndarray | None = None
+        self._boundary: np.ndarray = np.zeros(0, np.int32)
+        self._provide_nodes: np.ndarray = np.zeros(0, np.int32)
+        # edge masks (static global edge list)
+        self._local_edge_mask: np.ndarray | None = None  # layer-0: in-client only
+        self._cross_edge_mask: np.ndarray | None = None  # + cross training edges
+        self.communicated_bytes = 0
+        self.skipped_bytes = 0
+
+    # ------------------------------------------------------------- setup
+    def _before_training(self) -> None:
+        dc = self.trainer.dataset_collection
+        dc.remove_dataset(phase=MachineLearningPhase.Test)
+        dc.remove_dataset(phase=MachineLearningPhase.Validation)
+        if self.config.distribute_init_parameters:
+            self._get_result_from_server()
+            if self._stopped():
+                return
+        self._exchange_training_node_indices()
+        self._prune_edges()
+        if self._share_feature:
+            self.trainer.append_named_hook(
+                ExecutorHookPoint.OPTIMIZER_STEP,
+                "shared_feature_step",
+                self._shared_feature_step,
+            )
+        self._register_aggregation()
+
+    @property
+    def training_dataset(self):
+        return self.trainer.dataset_collection.get_dataset(MachineLearningPhase.Training)
+
+    def _exchange_training_node_indices(self) -> None:
+        graph = self.training_dataset.inputs
+        own_training = np.nonzero(graph["mask"])[0].astype(np.int32)
+        message = Message(
+            in_round=True,
+            other_data={"training_node_indices": own_training.tolist()},
+        )
+        self.send_data_to_server(message)
+        result = self._get_data_from_server()
+        merged = result.other_data["training_node_indices"]
+        self._own_nodes = own_training
+        others: set[int] = set()
+        for worker_id, indices in merged.items():
+            if int(worker_id) != self.worker_id:
+                others.update(int(i) for i in indices)
+        # disjointness assert (reference graph_worker.py:81-84)
+        assert not others.intersection(own_training.tolist())
+        self._other_training_node_indices = others
+
+    def _prune_edges(self) -> None:
+        graph = self.training_dataset.inputs
+        edge_index = graph["edge_index"]
+        src, dst = edge_index[0], edge_index[1]
+        own = np.zeros(len(self.training_dataset.targets), bool)
+        own[self._own_nodes] = True
+        other_training = np.zeros_like(own)
+        other_training[list(self._other_training_node_indices)] = True
+
+        in_client = own[src] & own[dst]
+        cross = (own[src] & other_training[dst]) | (other_training[src] & own[dst])
+        if self._edge_drop_rate > 0:
+            rng = np.random.default_rng(self.config.seed * 131 + self.worker_id)
+            cross &= rng.random(cross.shape) >= self._edge_drop_rate
+        self._local_edge_mask = in_client.astype(np.float32)
+        self._cross_edge_mask = (in_client | cross).astype(np.float32)
+        # boundary = other clients' training nodes I still have edges to
+        cross_src = np.unique(
+            np.concatenate(
+                [src[cross & other_training[src]], dst[cross & other_training[dst]]]
+            )
+        )
+        self._boundary = cross_src.astype(np.int32)
+        # nodes whose embeddings I provide: my training nodes on kept cross edges
+        provide = np.unique(
+            np.concatenate([src[cross & own[src]], dst[cross & own[dst]]])
+        )
+        self._provide_nodes = provide.astype(np.int32)
+        # default mask used by the trainer's standard (non-exchange) path
+        graph["edge_mask"] = (
+            self._cross_edge_mask if self._share_feature else self._local_edge_mask
+        )
+        get_logger().info(
+            "%s: %d in-client edges, %d cross edges kept, boundary %d nodes",
+            self.name,
+            int(in_client.sum()),
+            int(cross.sum() if isinstance(cross, np.ndarray) else 0),
+            len(self._boundary),
+        )
+
+    # ----------------------------------------------------- per-step exchange
+    def _shared_feature_step(self, executor, batch, step_rng, **kwargs) -> None:
+        trainer = executor
+        params = trainer.params
+        model = trainer.model_ctx.module
+        variables = {"params": unflatten_nested(params)}
+        inputs_local = dict(batch["input"])
+        inputs_local["edge_mask"] = jnp.asarray(self._local_edge_mask)
+        inputs_cross = dict(batch["input"])
+        inputs_cross["edge_mask"] = jnp.asarray(self._cross_edge_mask)
+
+        h = model.apply(variables, inputs_local, train=False, method=model.embed)
+
+        payload = {
+            "node_embedding": np.asarray(h[self._provide_nodes]),
+            "node_indices": self._provide_nodes,
+            "boundary": self._boundary,
+        }
+        message = Message(in_round=True, other_data=payload)
+        self.communicated_bytes += param_nbytes(payload)
+        self.send_data_to_server(message)
+        result = self._get_data_from_server()
+        received = np.asarray(result.other_data["node_embedding"])
+        received_ids = np.asarray(result.other_data["node_indices"], dtype=np.int32)
+        self.communicated_bytes += received.nbytes
+
+        h_received = jnp.zeros(h.shape, h.dtype)
+        received_mask = jnp.zeros((h.shape[0], 1), h.dtype)
+        if len(received_ids):
+            h_received = h_received.at[received_ids].set(jnp.asarray(received))
+            received_mask = received_mask.at[received_ids].set(1.0)
+        h_received = jax.lax.stop_gradient(h_received)
+        received_mask = jax.lax.stop_gradient(received_mask)
+
+        def loss_fn(p):
+            vs = {"params": unflatten_nested(p)}
+            h_local = model.apply(vs, inputs_local, train=True, method=model.embed,
+                                  rngs={"dropout": step_rng})
+            h_mix = h_local * (1.0 - received_mask) + h_received * received_mask
+            logits = model.apply(
+                vs,
+                h_mix,
+                inputs_cross,
+                train=True,
+                method=model.head,
+                rngs={"dropout": step_rng},
+            )
+            from ..models.registry import masked_ce_loss
+
+            loss, aux = masked_ce_loss(logits, batch["target"], batch["mask"])
+            return loss, aux
+
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = trainer.engine.optimizer.update(
+            grads, trainer.opt_state, params
+        )
+        import optax
+
+        new_params = optax.apply_updates(params, updates)
+        trainer._params = new_params
+        trainer._opt_state = opt_state
+
+    # ------------------------------------------------------------ artifacts
+    def _after_training(self) -> None:
+        super()._after_training()
+        stat = {
+            "communicated_bytes": int(self.communicated_bytes),
+            "skipped_bytes": int(self.skipped_bytes),
+            "boundary_size": int(len(self._boundary)),
+            "edge_count": int(
+                self._cross_edge_mask.sum() if self._cross_edge_mask is not None else 0
+            ),
+            "node_count": int(len(self._own_nodes) if self._own_nodes is not None else 0),
+        }
+        with open(
+            os.path.join(self.save_dir, "graph_worker_stat.json"), "wt", encoding="utf8"
+        ) as f:
+            json.dump(stat, f)
+
+    def _get_sent_data(self):
+        data = super()._get_sent_data()
+        return data

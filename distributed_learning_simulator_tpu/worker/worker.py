@@ -1,0 +1,106 @@
+"""Worker base: the per-client round loop.
+
+TPU-native equivalent of ``simulation_lib/worker/worker.py:15-95``.  A worker
+runs ``trainer.train()`` once per round until ``_round_num > config.round``
+or a force-stop; subclass hooks fire through the trainer's hook points.
+Device locks/gevent context of the reference are unnecessary here (one
+process, XLA owns the device); workers run as host threads that block on
+their endpoint.
+"""
+
+import json
+import os
+from functools import cached_property
+from typing import Any
+
+from ..engine.executor import Trainer
+from ..executor import Executor
+from ..ml_type import MachineLearningPhase
+from ..practitioner import Practitioner
+from ..utils.logging import get_logger
+
+
+class Worker(Executor):
+    def __init__(
+        self,
+        task_id: int | None,
+        endpoint,
+        practitioner: Practitioner,
+        config=None,
+        task_context=None,
+        **kwargs: Any,
+    ) -> None:
+        worker_id = practitioner.worker_id
+        name = f"worker {worker_id}"
+        if task_id is not None:
+            name = f"worker {worker_id} of {task_id}"
+        super().__init__(config=config, name=name, task_context=task_context)
+        self._practitioner = practitioner
+        self._endpoint = endpoint
+        self._round_num = 0
+        self._force_stop = False
+
+    @property
+    def worker_id(self) -> int:
+        return self._practitioner.worker_id
+
+    @cached_property
+    def trainer(self) -> Trainer:
+        dataset_collection = self._practitioner.create_dataset_collection(self.config)
+        trainer = Trainer(
+            self.config,
+            dataset_collection,
+            self._task_context.model_ctx,
+            self._task_context.engine,
+            seed=self.config.seed + self.worker_id + 1,
+            name=self.name,
+        )
+        trainer.batch_loss_log_enabled = False  # reference disables batch_loss_logger
+        return trainer
+
+    def _offload_from_device(self) -> None:
+        pass
+
+    def _before_training(self) -> None:
+        pass
+
+    def _after_training(self) -> None:
+        # reference dumps hyper_parameter.pk via dill (worker.py:51-55);
+        # we write a portable json
+        import dataclasses
+
+        hp = self.trainer.hyper_parameter
+        with open(
+            os.path.join(self.save_dir, "hyper_parameter.json"), "wt", encoding="utf8"
+        ) as f:
+            json.dump(dataclasses.asdict(hp), f)
+        if self.config.save_performance_metric:
+            # per-epoch metrics consumed by analysis/analyze_round.py
+            # (reference: toolbox visualizer's performance_metric.json)
+            with open(
+                os.path.join(self.save_dir, "performance_metric.json"),
+                "wt",
+                encoding="utf8",
+            ) as f:
+                json.dump(self.trainer.performance_metric.epoch_metrics, f)
+
+    def _stopped(self) -> bool:
+        return self._round_num > self.config.round or self._force_stop
+
+    def start(self, **kwargs: Any) -> None:
+        first_training = True
+        self._round_num = 1
+        self._force_stop = False
+        with self._get_execution_context():
+            while not self._stopped():
+                if first_training:
+                    self._before_training()
+                    first_training = False
+                    if self._stopped():
+                        break
+                self.trainer.set_visualizer_prefix(f"round: {self._round_num},")
+                self.trainer.train(**kwargs)
+                self._round_num += 1
+            get_logger().debug("finish %s", self.name)
+            self._endpoint.close()
+            self._after_training()

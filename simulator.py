@@ -1,0 +1,19 @@
+"""CLI entry point.
+
+Mirrors the reference's ``simulator.py``:
+
+    python3 simulator.py --config-name fed_avg/mnist.yaml ++fed_avg.round=1 ...
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.dirname(__file__)))
+
+from distributed_learning_simulator_tpu.config import load_config
+from distributed_learning_simulator_tpu.training import train
+
+if __name__ == "__main__":
+    config = load_config(sys.argv[1:])
+    result = train(config=config)
+    print(result.get("performance", {}))

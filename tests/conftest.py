@@ -1,0 +1,28 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh (no TPU needed),
+mirroring the fake-cluster testing stance of the reference (SURVEY.md §4).
+
+The container's ``sitecustomize`` registers the axon TPU platform and pins
+``jax_platforms`` before any test code runs, so the env var alone is not
+enough — override the jax config directly before the backend initializes.
+"""
+
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_session_dir(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    return tmp_path

@@ -1,0 +1,61 @@
+"""Analysis tooling tests: session loaders + communication cost model."""
+
+import json
+import os
+
+from distributed_learning_simulator_tpu.analysis import (
+    CommunicationCostModel,
+    Session,
+)
+from distributed_learning_simulator_tpu.analysis.analyze_log import scrape_log
+from distributed_learning_simulator_tpu.analysis.analyze_round import (
+    collect_round_metrics,
+)
+
+
+def _fake_session(tmp_path):
+    server = tmp_path / "run1" / "server"
+    server.mkdir(parents=True)
+    (server / "round_record.json").write_text(
+        json.dumps(
+            {
+                "1": {"test_accuracy": 0.5, "test_loss": 1.2},
+                "2": {"test_accuracy": 0.7, "test_loss": 0.9},
+            }
+        )
+    )
+    worker = tmp_path / "run1" / "worker_0"
+    worker.mkdir()
+    (worker / "hyper_parameter.json").write_text(json.dumps({"epoch": 2}))
+    return tmp_path / "run1"
+
+
+def test_session_loader(tmp_path):
+    session = Session(str(_fake_session(tmp_path)))
+    assert session.last_test_acc == 0.7
+    assert abs(session.mean_test_acc - 0.6) < 1e-9
+    assert session.hyper_parameters["worker_0"]["epoch"] == 2
+
+
+def test_collect_round_metrics(tmp_path):
+    _fake_session(tmp_path)
+    table = collect_round_metrics(str(tmp_path))
+    assert table["test_accuracy"][1] == [0.5]
+    assert table["test_accuracy"][2] == [0.7]
+
+
+def test_cost_model_and_scraper(tmp_path):
+    model = CommunicationCostModel(parameter_count=1000, worker_number=4, rounds=10)
+    full = model.fed_avg_bytes()
+    assert full == 1000 * 4 * (2 * 10 * 4 + 4)
+    assert model.fed_paq_bytes(quant_bytes=1.0) < full
+    obd = model.fed_obd_bytes(dropout_rate=0.9, compression_ratios=[0.25])
+    assert obd < full
+
+    log = tmp_path / "run.log"
+    log.write_text(
+        "12:00 INFO send_num 123\n12:01 INFO NNADQ compression ratio: 0.250000\n"
+    )
+    scraped = scrape_log(str(log))
+    assert scraped["send_nums"] == [123]
+    assert scraped["compression_ratios"] == [0.25]

@@ -1,0 +1,31 @@
+"""Concurrent-task canary (reference
+``simulation_lib/test/test_concurrent.py:11-46``: five simultaneous FedAvg
+tasks through the public ``train(practitioners=...)`` /
+``get_training_result`` API — a deadlock/crash canary)."""
+
+from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+from distributed_learning_simulator_tpu.practitioner import create_practitioners
+from distributed_learning_simulator_tpu.training import get_training_result, train
+
+
+def test_concurrent_tasks(tmp_session_dir):
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        distributed_algorithm="fed_avg",
+        worker_number=3,
+        batch_size=32,
+        round=1,
+        epoch=1,
+        learning_rate=0.05,
+        dataset_kwargs={"train_size": 96, "val_size": 32, "test_size": 32},
+    )
+    practitioners = create_practitioners(config)
+    task_ids = [
+        train(config, practitioners=practitioners, return_task_id=True)
+        for _ in range(3)
+    ]
+    assert len(set(task_ids)) == 3
+    for task_id in task_ids:
+        result = get_training_result(task_id)
+        assert result["performance"]

@@ -1,0 +1,128 @@
+"""Unit tests: pytree utils, codecs, config, samplers."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_learning_simulator_tpu.config import (
+    DistributedTrainingConfig,
+    load_config,
+)
+from distributed_learning_simulator_tpu.data import create_dataset_collection
+from distributed_learning_simulator_tpu.ml_type import MachineLearningPhase as Phase
+from distributed_learning_simulator_tpu.ops.pytree import (
+    cat_params_to_vector,
+    params_add,
+    params_diff,
+    params_from_vector_like,
+)
+from distributed_learning_simulator_tpu.ops.quantization import (
+    NNADQ,
+    check_compression_ratio,
+    stochastic_quantization,
+)
+from distributed_learning_simulator_tpu.sampler import get_dataset_collection_sampler
+
+
+def _params():
+    return {
+        "a/kernel": jnp.arange(12, dtype=jnp.float32).reshape(3, 4) / 7.0,
+        "a/bias": jnp.ones((4,), jnp.float32),
+        "b/kernel": -jnp.ones((2, 2), jnp.float32),
+    }
+
+
+def test_vector_roundtrip():
+    params = _params()
+    vec = cat_params_to_vector(params)
+    assert vec.shape == (12 + 4 + 4,)
+    back = params_from_vector_like(vec, params)
+    for k in params:
+        np.testing.assert_allclose(back[k], params[k])
+
+
+def test_diff_add_roundtrip():
+    params = _params()
+    shifted = {k: v + 0.5 for k, v in params.items()}
+    delta = params_diff(shifted, params)
+    restored = params_add(params, delta)
+    for k in params:
+        np.testing.assert_allclose(restored[k], shifted[k], rtol=1e-6)
+
+
+def test_stochastic_quantization_roundtrip():
+    quant, dequant = stochastic_quantization(255)
+    tree = _params()
+    blob = quant(tree, seed=3)
+    back = dequant(blob)
+    for k in tree:
+        np.testing.assert_allclose(np.asarray(back[k]), np.asarray(tree[k]), atol=2e-2)
+    big = {"w": jnp.ones((64, 64), jnp.float32) * 0.3}
+    ratio = check_compression_ratio(big, quant(big, seed=1))
+    assert ratio < 0.5  # 8-bit levels + 1-bit signs vs float32
+
+
+def test_nnadq_roundtrip():
+    codec = NNADQ(weight=0.05)
+    tree = _params()
+    blob = codec.quant(tree)
+    back = codec.dequant(blob)
+    for k in tree:
+        np.testing.assert_allclose(np.asarray(back[k]), np.asarray(tree[k]), atol=2e-1)
+    big = {"w": jnp.linspace(-1, 1, 4096, dtype=jnp.float32).reshape(64, 64)}
+    assert check_compression_ratio(big, codec.quant(big)) < 0.5
+
+
+def test_config_load_and_overrides():
+    config = load_config(
+        [
+            "--config-name",
+            "fed_avg/mnist.yaml",
+            "++fed_avg.round=2",
+            "++fed_avg.worker_number=3",
+            "++fed_avg.algorithm_kwargs.random_client_number=2",
+        ]
+    )
+    assert config.dataset_name == "MNIST"
+    assert config.model_name == "LeNet5"
+    assert config.round == 2
+    assert config.worker_number == 3
+    assert config.algorithm_kwargs["random_client_number"] == 2
+    assert config.save_dir.startswith("session")
+
+
+def _dc(train_size=256):
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST", dataset_kwargs={"train_size": train_size}
+    )
+    return create_dataset_collection(config)
+
+
+def test_iid_sampler_partitions():
+    dc = _dc()
+    sampler = get_dataset_collection_sampler("iid", dc, 4)
+    all_idx = np.concatenate(
+        [sampler.sample(i)[Phase.Training] for i in range(4)]
+    )
+    assert len(all_idx) == dc.dataset_size(Phase.Training)
+    assert len(np.unique(all_idx)) == len(all_idx)
+
+
+def test_random_label_iid_sampler():
+    dc = _dc()
+    sampler = get_dataset_collection_sampler(
+        "random_label_iid", dc, 4, sampled_class_number=5
+    )
+    train = dc.get_dataset(Phase.Training)
+    for i in range(4):
+        idx = sampler.sample(i)[Phase.Training]
+        labels = set(np.unique(train.targets[idx]).tolist())
+        assert len(labels) <= 5
+
+
+@pytest.mark.parametrize("name", ["MNIST", "CIFAR10", "imdb", "Cora"])
+def test_dataset_registry(name):
+    config = DistributedTrainingConfig(dataset_name=name)
+    dc = create_dataset_collection(config)
+    assert dc.num_classes > 1
+    assert dc.dataset_size(Phase.Training) > 0

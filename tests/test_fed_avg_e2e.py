@@ -1,0 +1,52 @@
+"""End-to-end FedAvg slice (mirrors the reference's smoke matrix,
+``test.sh:2``: fed_avg/mnist with 2 workers, 1 round, 1 epoch)."""
+
+import json
+import os
+
+from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+from distributed_learning_simulator_tpu.training import train
+
+
+def make_config(**overrides) -> DistributedTrainingConfig:
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        distributed_algorithm="fed_avg",
+        optimizer_name="SGD",
+        worker_number=2,
+        batch_size=32,
+        round=1,
+        epoch=1,
+        learning_rate=0.05,
+        dataset_kwargs={"train_size": 256, "val_size": 64, "test_size": 64},
+    )
+    for key, value in overrides.items():
+        setattr(config, key, value)
+    return config
+
+
+def test_fed_avg_end_to_end(tmp_session_dir):
+    config = make_config(round=2)
+    result = train(config)
+    stat = result["performance"]
+    assert len(stat) == 2
+    for round_stat in stat.values():
+        assert 0.0 <= round_stat["test_accuracy"] <= 1.0
+    server_dir = os.path.join(config.save_dir, "server")
+    record_path = None
+    for root, _dirs, files in os.walk("session"):
+        if "round_record.json" in files:
+            record_path = os.path.join(root, "round_record.json")
+    assert record_path is not None
+    with open(record_path, encoding="utf8") as f:
+        record = json.load(f)
+    assert len(record) == 2
+
+
+def test_fed_avg_learns(tmp_session_dir):
+    # synthetic MNIST is nearly linearly separable: 3 rounds must beat chance
+    config = make_config(round=3, epoch=2)
+    result = train(config)
+    final = max(result["performance"].values(), key=lambda s: s["test_accuracy"])
+    assert final["test_accuracy"] > 0.5

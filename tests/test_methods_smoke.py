@@ -1,0 +1,97 @@
+"""Per-method 1-round smoke matrix (mirrors the reference's ``test.sh`` +
+``other_method_test.sh`` — SURVEY.md §4), on tiny synthetic data."""
+
+import pytest
+
+from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+from distributed_learning_simulator_tpu.training import train
+
+
+def tiny_config(algo: str, **overrides) -> DistributedTrainingConfig:
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        distributed_algorithm=algo,
+        optimizer_name="SGD",
+        worker_number=2,
+        batch_size=32,
+        round=1,
+        epoch=1,
+        learning_rate=0.05,
+        dataset_kwargs={"train_size": 128, "val_size": 32, "test_size": 32},
+    )
+    for key, value in overrides.items():
+        setattr(config, key, value)
+    return config
+
+
+def run(config) -> dict:
+    result = train(config)
+    assert result["performance"], "no round stats recorded"
+    for stat in result["performance"].values():
+        assert 0.0 <= stat["test_accuracy"] <= 1.0
+    return result
+
+
+def test_fed_paq(tmp_session_dir):
+    run(tiny_config("fed_paq"))
+
+
+def test_fed_dropout_avg(tmp_session_dir):
+    run(
+        tiny_config(
+            "fed_dropout_avg", algorithm_kwargs={"dropout_rate": 0.3}
+        )
+    )
+
+
+def test_sign_sgd(tmp_session_dir):
+    config = tiny_config("sign_SGD", distribute_init_parameters=False)
+    result = train(config)
+    # per-step method: one final test metric recorded at exit
+    assert 0.0 <= result["performance"][1]["test_accuracy"] <= 1.0
+
+
+def test_single_model_afd(tmp_session_dir):
+    run(tiny_config("single_model_afd", algorithm_kwargs={"dropout_rate": 0.3}))
+
+
+def test_fed_obd(tmp_session_dir):
+    config = tiny_config(
+        "fed_obd",
+        round=2,
+        algorithm_kwargs={"second_phase_epoch": 1, "dropout_rate": 0.5},
+        endpoint_kwargs={"server": {"weight": 0.01}, "worker": {"weight": 0.01}},
+    )
+    run(config)
+
+
+def test_gtg_shapley(tmp_session_dir):
+    config = tiny_config("GTG_shapley_value", worker_number=3)
+    result = run(config)
+    assert "sv" in result
+    assert set(result["sv"]) == {1}
+    assert len(result["sv"][1]) == 3
+
+
+def test_fed_gnn(tmp_session_dir):
+    config = DistributedTrainingConfig(
+        dataset_name="Cora",
+        model_name="TwoGCN",
+        distributed_algorithm="fed_gnn",
+        worker_number=2,
+        round=1,
+        epoch=1,
+        learning_rate=0.01,
+        dataset_kwargs={},
+        algorithm_kwargs={"share_feature": True, "edge_drop_rate": 0.5},
+    )
+    run(config)
+
+
+def test_random_selection(tmp_session_dir):
+    config = tiny_config(
+        "fed_avg", worker_number=3, round=2,
+        algorithm_kwargs={"random_client_number": 2},
+    )
+    run(config)

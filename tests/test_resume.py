@@ -1,0 +1,41 @@
+"""Round checkpoint/resume: a second session continues from the first
+session's latest aggregated model and round number (capability the reference
+lacks — SURVEY.md §5 "a killed run restarts from round 1")."""
+
+import os
+
+from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+from distributed_learning_simulator_tpu.training import train
+
+
+def _config(**overrides):
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        distributed_algorithm="fed_avg",
+        worker_number=2,
+        batch_size=32,
+        round=2,
+        epoch=1,
+        learning_rate=0.05,
+        dataset_kwargs={"train_size": 128, "val_size": 32, "test_size": 32},
+    )
+    for key, value in overrides.items():
+        setattr(config, key, value)
+    return config
+
+
+def test_resume_from_previous_session(tmp_session_dir):
+    first = _config()
+    first.load_config_and_process()
+    result1 = train(first)
+    assert set(result1["performance"]) == {1, 2}
+    assert os.path.isdir(os.path.join(first.save_dir, "aggregated_model"))
+
+    resumed = _config(round=4, algorithm_kwargs={"resume_dir": first.save_dir})
+    resumed.load_config_and_process()
+    result2 = train(resumed)
+    # rounds 1-2 restored verbatim from the first session, 3-4 fresh
+    assert set(result2["performance"]) == {1, 2, 3, 4}
+    assert result2["performance"][1] == result1["performance"][1]
+    assert result2["performance"][2] == result1["performance"][2]

@@ -1,0 +1,213 @@
+"""Generate the conf/ tree (same YAML surface as the reference's conf/**)."""
+
+import os
+
+ROOT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "conf")
+
+GLOBAL = """cache_transforms: cpu
+log_level: INFO
+save_performance_metric: false
+use_slow_performance_metrics: true
+merge_validation_to_training_set: false
+use_amp: false
+"""
+
+VISION = {
+    "mnist": ("MNIST", "LeNet5", 0.01),
+    "cifar10": ("CIFAR10", "densenet40", 0.1),
+    "cifar100": ("CIFAR100", "densenet40", 0.1),
+    "imagenet": ("IMAGENET", "resnet18", 0.1),
+}
+IMDB_BLOCK = """dataset_name: imdb
+model_name: TransformerClassificationModel
+optimizer_name: SGD
+worker_number: {workers}
+batch_size: 64
+round: {round}
+learning_rate_scheduler_name: CosineAnnealingLR
+epoch: {epoch}
+learning_rate: 0.01
+dataset_kwargs:
+  max_len: 300
+  tokenizer:
+    type: spacy
+model_kwargs:
+  max_len: 300
+  word_vector_name: glove.6B.100d
+  num_encoder_layer: 2
+  d_model: 100
+  nhead: 5
+"""
+
+
+def vision_block(ds, workers=10, rounds=100, epoch=5):
+    name, model, lr = VISION[ds]
+    return (
+        f"dataset_name: {name}\nmodel_name: {model}\n"
+        f"optimizer_name: SGD\nworker_number: {workers}\nbatch_size: 64\n"
+        f"round: {rounds}\nlearning_rate_scheduler_name: CosineAnnealingLR\n"
+        f"epoch: {epoch}\nlearning_rate: {lr}\n"
+    )
+
+
+def write(path, body, algo):
+    path = os.path.join(ROOT, path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf8") as f:
+        f.write(f"distributed_algorithm: {algo}\n" + body)
+
+
+def main():
+    os.makedirs(ROOT, exist_ok=True)
+    with open(os.path.join(ROOT, "global.yaml"), "w", encoding="utf8") as f:
+        f.write(GLOBAL)
+
+    # fed_avg
+    write("fed_avg/mnist.yaml", vision_block("mnist", rounds=20, epoch=2), "fed_avg")
+    for ds in ("cifar10", "cifar100", "imagenet"):
+        write(f"fed_avg/{ds}.yaml", vision_block(ds), "fed_avg")
+    write("fed_avg/imdb.yaml", IMDB_BLOCK.format(workers=10, round=100, epoch=5), "fed_avg")
+
+    # fed_obd (+_sq)
+    obd_kwargs = (
+        "endpoint_kwargs:\n  server:\n    weight: 0.01\n  worker:\n    weight: 0.01\n"
+        "algorithm_kwargs:\n  second_phase_epoch: 10\n  dropout_rate: 0.9\n"
+        "  random_client_number: 5\n"
+    )
+    for ds in ("cifar10", "cifar100"):
+        write(f"fed_obd/{ds}.yaml", vision_block(ds) + obd_kwargs, "fed_obd")
+    write(
+        "fed_obd/imdb.yaml",
+        IMDB_BLOCK.format(workers=10, round=100, epoch=5) + obd_kwargs,
+        "fed_obd",
+    )
+    sq_kwargs = (
+        "algorithm_kwargs:\n  second_phase_epoch: 10\n  dropout_rate: 0.9\n"
+        "  random_client_number: 5\n"
+    )
+    write("fed_obd_sq/cifar100.yaml", vision_block("cifar100") + sq_kwargs, "fed_obd_sq")
+
+    # fed_paq
+    paq_kwargs = "algorithm_kwargs:\n  random_client_number: 5\n"
+    for ds in ("cifar10", "cifar100"):
+        write(f"fed_paq/{ds}.yaml", vision_block(ds) + paq_kwargs, "fed_paq")
+    write(
+        "fed_paq/imdb.yaml",
+        IMDB_BLOCK.format(workers=10, round=100, epoch=5) + paq_kwargs,
+        "fed_paq",
+    )
+
+    # fed_dropout_avg
+    fda_kwargs = "algorithm_kwargs:\n  dropout_rate: 0.3\n  random_client_number: 5\n"
+    for ds in ("cifar10", "cifar100"):
+        write(f"fed_dropout_avg/{ds}.yaml", vision_block(ds) + fda_kwargs, "fed_dropout_avg")
+    write(
+        "fed_dropout_avg/imdb.yaml",
+        IMDB_BLOCK.format(workers=10, round=100, epoch=5) + fda_kwargs,
+        "fed_dropout_avg",
+    )
+
+    # sign_sgd
+    sign_extra = "distribute_init_parameters: false\n"
+    for ds in ("cifar10", "cifar100"):
+        write(
+            f"sign_sgd/{ds}.yaml",
+            vision_block(ds, rounds=1, epoch=100) + sign_extra,
+            "sign_SGD",
+        )
+    write(
+        "sign_sgd/imdb.yaml",
+        IMDB_BLOCK.format(workers=10, round=1, epoch=100) + sign_extra,
+        "sign_SGD",
+    )
+
+    # smafd (single_model_afd)
+    afd_kwargs = "algorithm_kwargs:\n  random_client_number: 5\n  dropout_rate: 0.3\n"
+    for ds in ("cifar10", "cifar100"):
+        write(f"smafd/{ds}.yaml", vision_block(ds) + afd_kwargs, "single_model_afd")
+    write(
+        "smafd/imdb.yaml",
+        IMDB_BLOCK.format(workers=10, round=100, epoch=5) + afd_kwargs,
+        "single_model_afd",
+    )
+
+    # shapley value
+    write("gtg_sv/mnist.yaml", vision_block("mnist", rounds=20, epoch=2), "GTG_shapley_value")
+    for ds in ("cifar10", "cifar100"):
+        write(f"gtg_sv/{ds}.yaml", vision_block(ds), "GTG_shapley_value")
+    write(
+        "gtg_sv/imdb.yaml",
+        IMDB_BLOCK.format(workers=10, round=100, epoch=5),
+        "GTG_shapley_value",
+    )
+    for ds in ("cifar10", "cifar100"):
+        write(f"multiround_sv/{ds}.yaml", vision_block(ds), "multiround_shapley_value")
+
+    # graph FL
+    gnn_kwargs = (
+        "algorithm_kwargs:\n  share_feature: true\n  batch_number: 10\n"
+        "  edge_drop_rate: 0.99\n  num_neighbor: 10\n"
+    )
+    for ds, model, workers in (
+        ("cs", "TwoGCN", 50),
+        ("yelp", "TwoGCN", 50),
+        ("amazonproduct", "TwoGCN", 50),
+    ):
+        dataset = {"cs": "Coauthor_CS", "yelp": "yelp", "amazonproduct": "AmazonProduct"}[ds]
+        body = (
+            f"dataset_name: {dataset}\nmodel_name: {model}\nepoch: 1\n"
+            f"learning_rate: 0.001\nweight_decay: 0\nround: 50\n"
+            f"worker_number: {workers}\nuse_amp: false\n" + gnn_kwargs
+        )
+        write(f"fed_gnn/{ds}.yaml", body, "fed_gnn")
+    write(
+        "fed_gcn/cs.yaml",
+        "dataset_name: Coauthor_CS\nmodel_name: TwoGCN\nepoch: 1\n"
+        "learning_rate: 0.001\nweight_decay: 0\nround: 50\nworker_number: 50\n"
+        + gnn_kwargs,
+        "fed_gcn",
+    )
+
+    # large_scale variants (100 clients, 50 selected)
+    for algo, extra in (
+        ("fed_avg", ""),
+        ("fed_paq", "algorithm_kwargs:\n  random_client_number: 50\n"),
+        (
+            "fed_obd",
+            "endpoint_kwargs:\n  server:\n    weight: 0.01\n  worker:\n    weight: 0.01\n"
+            "algorithm_kwargs:\n  second_phase_epoch: 10\n  dropout_rate: 0.3\n"
+            "  random_client_number: 50\n",
+        ),
+        (
+            "fed_dropout_avg",
+            "algorithm_kwargs:\n  dropout_rate: 0.3\n  random_client_number: 50\n",
+        ),
+        (
+            "smafd",
+            "algorithm_kwargs:\n  dropout_rate: 0.3\n  random_client_number: 50\n",
+        ),
+    ):
+        reg_name = {"smafd": "single_model_afd"}.get(algo, algo)
+        for ds in ("cifar10", "cifar100"):
+            write(
+                f"large_scale/{algo}/{ds}.yaml",
+                vision_block(ds, workers=100) + extra,
+                reg_name,
+            )
+        write(
+            f"large_scale/{algo}/imdb.yaml",
+            IMDB_BLOCK.format(workers=100, round=100, epoch=5) + extra,
+            reg_name,
+        )
+    write(
+        "large_scale/fed_obd/cifar100_sq.yaml",
+        vision_block("cifar100", workers=100)
+        + "algorithm_kwargs:\n  second_phase_epoch: 10\n  dropout_rate: 0.3\n"
+        "  random_client_number: 50\n",
+        "fed_obd_sq",
+    )
+    print(f"wrote conf tree under {ROOT}")
+
+
+if __name__ == "__main__":
+    main()
