@@ -1,0 +1,122 @@
+"""Long-context transformer classifier with sequence-parallel attention.
+
+No counterpart exists in the reference (its largest text model is a 2-layer
+d_model=100 classifier with ``max_len: 300`` — SURVEY.md §5); this model is
+the framework's long-context flagship: the sequence axis of a single
+client's forward/backward can be sharded over a mesh axis (``"sp"``) with
+exact attention computed by ring passes (``parallel/ring_attention.py``) or
+Ulysses all-to-alls.  On a single device (or ``sp_mesh=None``) it falls
+back to dense attention — same parameters, same math.
+"""
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .registry import ModelContext, example_batch, register_model
+from .text import sinusoidal_positions
+
+
+class LongContextSelfAttention(nn.Module):
+    d_model: int
+    nhead: int
+    sp_mesh: Any = None  # jax Mesh with an "sp" axis, or None
+    sp_impl: str = "ring"
+
+    @nn.compact
+    def __call__(self, x, pad_mask):
+        # deferred: models package is imported by engine, which parallel/
+        # also imports (package-level cycle)
+        from ..parallel.ring_attention import dense_attention, sharded_attention
+
+        batch, length, _ = x.shape
+        head_dim = self.d_model // self.nhead
+        qkv = nn.DenseGeneral((3, self.nhead, head_dim), name="qkv")(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.sp_mesh is None:
+            out = dense_attention(q, k, v, kv_mask=pad_mask)
+        else:
+            out = sharded_attention(
+                q, k, v, self.sp_mesh, axis_name="sp", impl=self.sp_impl,
+                kv_mask=pad_mask,
+            )
+        out = out.reshape(batch, length, self.nhead * head_dim)
+        return nn.Dense(self.d_model, name="out")(out)
+
+
+class LongContextEncoderLayer(nn.Module):
+    d_model: int
+    nhead: int
+    sp_mesh: Any = None
+    sp_impl: str = "ring"
+    dropout_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, x, pad_mask, train: bool = False):
+        y = LongContextSelfAttention(
+            self.d_model, self.nhead, self.sp_mesh, self.sp_impl
+        )(nn.LayerNorm()(x), pad_mask)
+        x = x + nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        y = nn.Dense(4 * self.d_model)(nn.LayerNorm()(x))
+        y = nn.gelu(y)
+        y = nn.Dense(self.d_model)(y)
+        return x + nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+
+
+class LongContextTransformer(nn.Module):
+    vocab_size: int
+    num_classes: int
+    d_model: int = 256
+    nhead: int = 8
+    num_encoder_layer: int = 4
+    max_len: int = 8192
+    pad_id: int = 0
+    sp_mesh: Any = None
+    sp_impl: str = "ring"
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        pad_mask = tokens != self.pad_id  # [B, L]
+        x = nn.Embed(self.vocab_size, self.d_model)(tokens)
+        x = x + sinusoidal_positions(self.max_len, self.d_model)[None, : tokens.shape[1]]
+        for _ in range(self.num_encoder_layer):
+            x = LongContextEncoderLayer(
+                self.d_model, self.nhead, self.sp_mesh, self.sp_impl
+            )(x, pad_mask, train=train)
+        x = nn.LayerNorm()(x)
+        denom = jnp.maximum(pad_mask.sum(axis=1, keepdims=True), 1)
+        pooled = (x * pad_mask[..., None]).sum(axis=1) / denom
+        return nn.Dense(self.num_classes)(pooled)
+
+
+@register_model("LongContextTransformer", "longcontexttransformer")
+def _long_context_transformer(
+    dataset_collection,
+    d_model: int = 256,
+    nhead: int = 8,
+    num_encoder_layer: int = 4,
+    max_len: int = 0,
+    sp_mesh: Any = None,
+    sp_impl: str = "ring",
+    **kwargs,
+) -> ModelContext:
+    meta = dataset_collection.metadata
+    module = LongContextTransformer(
+        vocab_size=meta.get("vocab_size", 32000),
+        num_classes=dataset_collection.num_classes,
+        d_model=d_model,
+        nhead=nhead,
+        num_encoder_layer=num_encoder_layer,
+        max_len=max_len or meta.get("max_len", 8192),
+        pad_id=meta.get("pad_id", 0),
+        sp_mesh=sp_mesh,
+        sp_impl=sp_impl,
+    )
+    return ModelContext(
+        name="LongContextTransformer",
+        module=module,
+        example_input=example_batch(dataset_collection),
+        num_classes=dataset_collection.num_classes,
+        dataset_type="text",
+    )

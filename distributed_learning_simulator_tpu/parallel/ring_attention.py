@@ -1,0 +1,225 @@
+"""Long-context sequence/context parallelism: ring attention + Ulysses.
+
+The reference has **no** sequence parallelism (SURVEY.md §5 "Long-context /
+sequence parallelism: absent") — its models are small classifiers.  For the
+TPU-native framework long context is first-class: a single client model can
+shard its *sequence* axis over the mesh and still compute exact attention.
+
+Two interchangeable strategies, both exact (not approximations):
+
+* ``ring_attention`` — blockwise attention with an online (streaming)
+  softmax.  Each device holds one sequence block of K/V; blocks rotate
+  around the ring via ``lax.ppermute`` while every device accumulates
+  attention for its local queries.  N-1 hops on ICI, O(T/N) memory per
+  device, numerically stable (running max / normalizer, the flash-attention
+  recurrence).
+* ``ulysses_attention`` — all-to-all sequence↔head re-sharding: each device
+  gathers the *full* sequence for ``H/N`` of the heads, runs dense local
+  attention, and scatters back.  Two ``all_to_all``s, preferable when the
+  head count is divisible by the mesh axis and sequence blocks are small.
+
+Both are pure functions over **local** shards designed to run inside
+``shard_map`` (see ``make_sequence_parallel_attention`` for the jitted
+full-array wrapper).  Causal masking uses global positions reconstructed
+from ``lax.axis_index``, so the sharded result matches dense attention up
+to float accumulation order.  Key-padding masks (``kv_mask``) are
+supported everywhere — they ride the ring alongside the K/V blocks.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map
+
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
+
+
+def _block_scores(q, k, scale):
+    # q: [B, Tq, H, D], k: [B, Tk, H, D] -> [B, H, Tq, Tk]
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+
+
+def _combined_mask(q_pos, k_pos, kv_mask, causal, batch):
+    """[B, Tq, Tk] boolean mask (True = may attend), or None if unmasked."""
+    mask = None
+    if causal:
+        mask = jnp.broadcast_to(
+            (q_pos[:, None] >= k_pos[None, :])[None],
+            (batch, q_pos.shape[0], k_pos.shape[0]),
+        )
+    if kv_mask is not None:
+        pad = jnp.broadcast_to(
+            kv_mask[:, None, :].astype(bool), (batch, q_pos.shape[0], k_pos.shape[0])
+        )
+        mask = pad if mask is None else (mask & pad)
+    return mask
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False, kv_mask=None):
+    """Exact attention over a ring-sharded sequence.
+
+    Arguments are the **local** sequence blocks inside ``shard_map``:
+    ``q/k/v: [B, T_local, H, D]`` (global sequence laid out in axis-index
+    order), ``kv_mask: [B, T_local]`` key-padding mask or None.  Returns the
+    local attention output ``[B, T_local, H, D]``.
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    batch, t_local, heads, dim = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(dim, jnp.float32))
+    q_pos = my_index * t_local + jnp.arange(t_local)
+
+    o = jnp.zeros((batch, heads, t_local, dim), jnp.float32)
+    m = jnp.full((batch, heads, t_local), _NEG_INF, jnp.float32)
+    l = jnp.zeros((batch, heads, t_local), jnp.float32)
+    mask_blk = (
+        jnp.ones((batch, t_local), bool) if kv_mask is None else kv_mask.astype(bool)
+    )
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, hop):
+        o, m, l, k_blk, v_blk, mask_blk = carry
+        kv_index = (my_index - hop) % axis_size
+        s = _block_scores(q, k_blk, scale)
+        k_pos = kv_index * t_local + jnp.arange(t_local)
+        mask = _combined_mask(q_pos, k_pos, mask_blk, causal, batch)
+        s = jnp.where(mask[:, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None]) * mask[:, None]
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        mask_blk = jax.lax.ppermute(mask_blk, axis_name, perm)
+        return (o, m_new, l, k_blk, v_blk, mask_blk), None
+
+    (o, m, l, _, _, _), _ = jax.lax.scan(
+        step, (o, m, l, k, v, mask_blk), jnp.arange(axis_size)
+    )
+    out = o / jnp.maximum(l, 1e-20)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False, kv_mask=None):
+    """Exact attention via all-to-all sequence↔head re-sharding.
+
+    Local blocks ``[B, T_local, H, D]``; requires ``H % axis_size == 0``.
+    After the first ``all_to_all`` every device holds the full sequence for
+    ``H / axis_size`` heads; dense attention runs locally; the second
+    ``all_to_all`` restores sequence sharding.
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    t_local = q.shape[1]
+
+    def seq_to_head(x):
+        # [B, T_local, H, D] -> [B, T_global, H/N, D]
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def head_to_seq(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qg, kg, vg = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    full_mask = (
+        jax.lax.all_gather(kv_mask.astype(bool), axis_name, axis=1, tiled=True)
+        if kv_mask is not None
+        else None
+    )
+    out = dense_attention(qg, kg, vg, causal=causal, kv_mask=full_mask)
+    return head_to_seq(out)
+
+
+def dense_attention(q, k, v, causal: bool = False, kv_mask=None):
+    """Single-device reference implementation (tests and the no-mesh
+    fallback path of ``LongContextTransformer``)."""
+    batch = q.shape[0]
+    dim = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(dim, jnp.float32))
+    s = _block_scores(q, k, scale)
+    mask = _combined_mask(
+        jnp.arange(q.shape[1]), jnp.arange(k.shape[1]), kv_mask, causal, batch
+    )
+    if mask is not None:
+        s = jnp.where(mask[:, None], s, _NEG_INF)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+    if mask is not None:
+        p = p * mask[:, None]
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def make_sequence_parallel_attention(
+    mesh: Mesh,
+    axis_name: str = "sp",
+    impl: str = "ring",
+    causal: bool = False,
+    with_kv_mask: bool = False,
+):
+    """Jitted full-array entry point: takes global ``[B, T, H, D]`` arrays
+    sharded ``P(None, axis_name)`` over the mesh and returns the globally
+    correct attention output with the same sharding.  With
+    ``with_kv_mask=True`` the returned function takes a fourth argument,
+    the ``[B, T]`` key-padding mask (sharded the same way)."""
+    inner = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
+    spec = P(None, axis_name)
+    sharding = NamedSharding(mesh, spec)
+
+    if with_kv_mask:
+
+        def local_fn(q, k, v, kv_mask):
+            return inner(
+                q, k, v, axis_name=axis_name, causal=causal, kv_mask=kv_mask
+            )
+
+        mapped = _shard_map(local_fn, mesh, (spec,) * 4, spec)
+        return jax.jit(
+            mapped, in_shardings=(sharding,) * 4, out_shardings=sharding
+        )
+
+    def local_fn(q, k, v):
+        return inner(q, k, v, axis_name=axis_name, causal=causal)
+
+    mapped = _shard_map(local_fn, mesh, (spec,) * 3, spec)
+    return jax.jit(
+        mapped, in_shardings=(sharding,) * 3, out_shardings=sharding
+    )
+
+
+def sharded_attention(q, k, v, mesh, axis_name="sp", impl="ring", causal=False, kv_mask=None):
+    """Global-array attention usable *inside* an outer jitted program (e.g.
+    a flax module's forward): nests ``shard_map`` over ``mesh`` so the
+    sequence axis stays device-resident and K/V blocks move over ICI."""
+    inner = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
+    spec = P(None, axis_name)
+
+    if kv_mask is None:
+
+        def local_fn(q, k, v):
+            return inner(q, k, v, axis_name=axis_name, causal=causal)
+
+        return _shard_map(local_fn, mesh, (spec,) * 3, spec)(q, k, v)
+
+    def local_fn(q, k, v, kv_mask):
+        return inner(q, k, v, axis_name=axis_name, causal=causal, kv_mask=kv_mask)
+
+    return _shard_map(local_fn, mesh, (spec, spec, spec, spec), spec)(q, k, v, kv_mask)
