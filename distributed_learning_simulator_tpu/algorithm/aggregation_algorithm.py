@@ -61,13 +61,25 @@ class AggregationAlgorithm:
         worker_ids = sorted(all_worker_data)
         assert worker_ids
         first = getattr(all_worker_data[worker_ids[0]], key)
+        use_pallas = jax.default_backend() == "tpu" and len(worker_ids) > 1
         result: Params = {}
         for name in first:
-            acc = None
-            for worker_id in worker_ids:
-                value = getattr(all_worker_data[worker_id], key)[name]
-                term = value.astype(jnp.float32) * weights[worker_id]
-                acc = term if acc is None else acc + term
+            values = [getattr(all_worker_data[w], key)[name] for w in worker_ids]
+            if use_pallas and values[0].size >= 8 * 128:
+                # fused multiply-accumulate kernel: no [C, N] weighted
+                # temporary (ops/pallas_kernels.py)
+                from ..ops.pallas_kernels import weighted_accum
+
+                stacked = jnp.stack(
+                    [jnp.asarray(v).reshape(-1) for v in values]
+                )
+                w_arr = jnp.asarray([weights[w] for w in worker_ids], jnp.float32)
+                acc = weighted_accum(stacked, w_arr).reshape(values[0].shape)
+            else:
+                acc = None
+                for value, worker_id in zip(values, worker_ids):
+                    term = value.astype(jnp.float32) * weights[worker_id]
+                    acc = term if acc is None else acc + term
             result[name] = acc.astype(first[name].dtype)
         return result
 

@@ -82,38 +82,61 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, kv_mask=None):
     batch, t_local, heads, dim = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(dim, jnp.float32))
     q_pos = my_index * t_local + jnp.arange(t_local)
+    has_mask = kv_mask is not None
 
-    o = jnp.zeros((batch, heads, t_local, dim), jnp.float32)
-    m = jnp.full((batch, heads, t_local), _NEG_INF, jnp.float32)
-    l = jnp.zeros((batch, heads, t_local), jnp.float32)
-    mask_blk = (
-        jnp.ones((batch, t_local), bool) if kv_mask is None else kv_mask.astype(bool)
-    )
-
-    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-
-    def step(carry, hop):
-        o, m, l, k_blk, v_blk, mask_blk = carry
-        kv_index = (my_index - hop) % axis_size
+    def accumulate(acc, k_blk, v_blk, mask_blk, kv_index):
+        """Online-softmax update with one K/V block (the flash-attention
+        recurrence)."""
+        o, m, l = acc
         s = _block_scores(q, k_blk, scale)
         k_pos = kv_index * t_local + jnp.arange(t_local)
         mask = _combined_mask(q_pos, k_pos, mask_blk, causal, batch)
-        s = jnp.where(mask[:, None], s, _NEG_INF)
+        if mask is not None:
+            s = jnp.where(mask[:, None], s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[..., None]) * mask[:, None]
+        p = jnp.exp(s - m_new[..., None])
+        if mask is not None:
+            p = p * mask[:, None]
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1)
         o = o * alpha[..., None] + jnp.einsum(
             "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
         )
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        mask_blk = jax.lax.ppermute(mask_blk, axis_name, perm)
-        return (o, m_new, l, k_blk, v_blk, mask_blk), None
+        return o, m_new, l
 
-    (o, m, l, _, _, _), _ = jax.lax.scan(
-        step, (o, m, l, k, v, mask_blk), jnp.arange(axis_size)
+    acc = (
+        jnp.zeros((batch, heads, t_local, dim), jnp.float32),
+        jnp.full((batch, heads, t_local), _NEG_INF, jnp.float32),
+        jnp.zeros((batch, heads, t_local), jnp.float32),
     )
+    mask0 = kv_mask.astype(bool) if has_mask else None
+    # hop 0: the local block, no communication
+    acc = accumulate(acc, k, v, mask0, my_index)
+
+    if axis_size > 1:
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+        def step(carry, hop):
+            # permute first, then accumulate: exactly N-1 hops on ICI
+            if has_mask:
+                o, m, l, k_blk, v_blk, mask_blk = carry
+                mask_blk = jax.lax.ppermute(mask_blk, axis_name, perm)
+            else:
+                o, m, l, k_blk, v_blk = carry
+                mask_blk = None
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            kv_index = (my_index - hop) % axis_size
+            o, m, l = accumulate((o, m, l), k_blk, v_blk, mask_blk, kv_index)
+            if has_mask:
+                return (o, m, l, k_blk, v_blk, mask_blk), None
+            return (o, m, l, k_blk, v_blk), None
+
+        carry = (*acc, k, v, mask0) if has_mask else (*acc, k, v)
+        carry, _ = jax.lax.scan(step, carry, jnp.arange(1, axis_size))
+        acc = carry[:3]
+
+    o, m, l = acc
     out = o / jnp.maximum(l, 1e-20)[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
@@ -128,6 +151,10 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False, kv_mask=Non
     """
     axis_size = jax.lax.psum(1, axis_name)
     t_local = q.shape[1]
+    assert q.shape[2] % axis_size == 0, (
+        f"ulysses needs head count {q.shape[2]} divisible by mesh axis "
+        f"{axis_name!r} size {axis_size}"
+    )
 
     def seq_to_head(x):
         # [B, T_local, H, D] -> [B, T_global, H/N, D]
