@@ -6,7 +6,10 @@ frees each worker's tensors as they arrive to bound memory, per-name weight
 accumulators (subclasses may return per-element weight arrays — see
 ``fed_dropout_avg``), and a batch fallback path.  Accumulation is a jitted
 device add in float32 with fixed arrival order instead of the reference's CPU
-float64 walk (SURVEY.md §7 hard-part 3).
+float64 walk (SURVEY.md §7 hard-part 3); setting
+``algorithm_kwargs.float64_parity: true`` switches to the native host
+float64 accumulator (``native/fastops.cc``) for bit-level reference-parity
+runs.
 """
 
 import functools
@@ -44,12 +47,51 @@ class FedAVGAlgorithm(AggregationAlgorithm):
     def _apply_total_weight(self, name: str, parameter, total_weight):
         return parameter / total_weight
 
+    @property
+    def _float64_parity(self) -> bool:
+        # parity mode implements plain scalar-weighted FedAvg only; subclass
+        # weighting/finalize hooks (e.g. fed_dropout_avg's per-element
+        # weights) are bypassed by the native accumulator, so never engage
+        # it for them
+        if type(self) is not FedAVGAlgorithm:
+            return False
+        server = getattr(self, "_server", None)
+        if server is None:
+            return False
+        return bool(server.config.algorithm_kwargs.get("float64_parity"))
+
+    def _process_worker_data_f64(self, data: ParameterMessage) -> None:
+        """Reference-parity path: host float64 streaming accumulation
+        (``simulation_lib/algorithm/fed_avg_algorithm.py:44``) via the
+        native runtime."""
+        import numpy as np
+
+        from ..native import Float64Accumulator
+
+        if not hasattr(self, "_f64_acc"):
+            self._f64_acc = {}
+        for name, value in data.parameter.items():
+            self._dtypes[name] = value.dtype
+            weight = self._get_weight(
+                dataset_size=data.dataset_size, name=name, parameter=value
+            )
+            arr = np.asarray(value, np.float32)
+            if name not in self._f64_acc:
+                self._f64_acc[name] = (Float64Accumulator(arr.size), arr.shape)
+            self._f64_acc[name][0].add(arr, float(weight))
+
     def process_worker_data(self, worker_id, worker_data, **kwargs) -> None:
         super().process_worker_data(worker_id, worker_data, **kwargs)
         if not self.accumulate:
             return
         data = self._all_worker_data.get(worker_id)
         if not isinstance(data, ParameterMessage):
+            return
+        if self._float64_parity:
+            self._process_worker_data_f64(data)
+            self._end_training |= data.end_training
+            self._merge_other_data(data.other_data)
+            data.parameter = {}
             return
         terms = {}
         for name, value in data.parameter.items():
@@ -85,6 +127,22 @@ class FedAVGAlgorithm(AggregationAlgorithm):
     def aggregate_worker_data(self) -> Message:
         if not self.accumulate:
             return self._aggregate_worker_data(self._all_worker_data)
+        if getattr(self, "_f64_acc", None):
+            import jax.numpy as _jnp
+
+            parameter = {
+                name: _jnp.asarray(acc.finalize().reshape(shape)).astype(
+                    self._dtypes[name]
+                )
+                for name, (acc, shape) in self._f64_acc.items()
+            }
+            self._f64_acc = {}
+            check_finite(parameter)
+            return ParameterMessage(
+                parameter=parameter,
+                end_training=self._end_training,
+                other_data=dict(self._other_data),
+            )
         assert self._parameter, "no worker parameters to aggregate"
         parameter = self._parameter
         self._parameter = {}
@@ -127,6 +185,7 @@ class FedAVGAlgorithm(AggregationAlgorithm):
 
     def clear_worker_data(self) -> None:
         super().clear_worker_data()
+        self._f64_acc = {}
         self._parameter = {}
         self._total_weights = {}
         self._dtypes = {}

@@ -28,8 +28,14 @@ def make_epoch_batches(
     pad = padded - n
     order = np.concatenate([order, np.zeros(pad, dtype=order.dtype)])
     mask = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
-    inputs = dataset.inputs[order].reshape(n_batches, batch_size, *dataset.inputs.shape[1:])
-    targets = dataset.targets[order].reshape(n_batches, batch_size)
+    # batch assembly via the native gather (one memcpy pass; falls back to
+    # numpy fancy indexing when the C++ runtime is unavailable)
+    from ..native import gather_rows
+
+    inputs = gather_rows(dataset.inputs, order).reshape(
+        n_batches, batch_size, *dataset.inputs.shape[1:]
+    )
+    targets = gather_rows(dataset.targets, order).reshape(n_batches, batch_size)
     return {
         "input": inputs,
         "target": targets,

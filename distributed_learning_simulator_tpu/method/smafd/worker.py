@@ -1,11 +1,19 @@
 """single_model_afd client: random whole-tensor dropout of the parameter
 delta with error feedback (truncated deltas accumulate in the residual and
 are retried next round).  Logs ``send_num`` the way the reference's analysis
-cost model expects (``analysis/analyze_log.py:191-209``)."""
+cost model expects (``analysis/analyze_log.py:191-209``).
+
+With ``algorithm_kwargs.topk_ratio`` set, per-tensor magnitude top-k
+(native ``nth_element`` threshold, ``native/fastops.cc``) replaces the
+whole-tensor dropout — the classical error-feedback compressor."""
 
 from typing import Any
 
+import jax.numpy as jnp
+import numpy as np
+
 from ...algorithm.random_dropout_algorithm import RandomDropoutAlgorithm
+from ...native import sparsify
 from ...ops.pytree import Params
 from ...utils.logging import get_logger
 from ...worker.error_feedback_worker import ErrorFeedbackWorker
@@ -14,13 +22,33 @@ from ...worker.error_feedback_worker import ErrorFeedbackWorker
 class SingleModelAFDWorker(ErrorFeedbackWorker):
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self._dropout = RandomDropoutAlgorithm(
-            dropout_rate=self.config.algorithm_kwargs["dropout_rate"],
-            seed=self.config.seed * 31 + self.worker_id,
-        )
+        self._topk_ratio = self.config.algorithm_kwargs.get("topk_ratio")
+        if self._topk_ratio is None:
+            self._dropout = RandomDropoutAlgorithm(
+                dropout_rate=self.config.algorithm_kwargs["dropout_rate"],
+                seed=self.config.seed * 31 + self.worker_id,
+            )
+
+    def _topk_sparsify(self, delta: Params) -> Params:
+        sent: Params = {}
+        for name, value in delta.items():
+            flat = np.asarray(value, np.float32).reshape(-1)
+            k = max(1, int(flat.size * self._topk_ratio))
+            indices, values = sparsify(flat, k)
+            dense = np.zeros_like(flat)
+            dense[indices] = values
+            sent[name] = jnp.asarray(dense.reshape(np.shape(value)))
+        return sent
 
     def _sparsify(self, delta: Params) -> Params:
-        sent = self._dropout.drop_parameters(delta)
-        send_num = sum(int(v.size) for v in sent.values())
+        if self._topk_ratio is not None:
+            sent = self._topk_sparsify(delta)
+            send_num = sum(
+                max(1, int(np.asarray(v).size * self._topk_ratio))
+                for v in delta.values()
+            )
+        else:
+            sent = self._dropout.drop_parameters(delta)
+            send_num = sum(int(v.size) for v in sent.values())
         get_logger().info("send_num %s", send_num)
         return sent

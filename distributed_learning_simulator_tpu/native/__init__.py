@@ -1,0 +1,219 @@
+"""ctypes bindings for the native host runtime (``native/fastops.cc``).
+
+The library is built on demand with ``g++`` (the image has no pybind11;
+plain C ABI + ctypes keeps the binding dependency-free).  Every entry point
+has a numpy fallback so the framework still runs where no compiler exists —
+``available()`` tells which path is active.
+
+Surface:
+* :class:`Float64Accumulator` — streaming float64 parameter aggregation,
+  the reference server's accumulation semantics
+  (``simulation_lib/algorithm/fed_avg_algorithm.py:44``) for bit-parity runs;
+* :func:`topk_abs_threshold` / :func:`sparsify` — error-feedback top-k
+  sparsification (``single_model_afd``);
+* :func:`gather_rows` — fused index-select batch assembly for the host
+  input pipeline;
+* :func:`permute_indices` — version-stable deterministic shuffling.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_SRC_DIR, "libfastops.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> bool:
+    src = os.path.join(_SRC_DIR, "fastops.cc")
+    if not os.path.exists(src):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", _SRC_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_LIB_PATH)
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _load():
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        # make is a fast no-op when the .so is current, and rebuilds when
+        # fastops.cc changed; a pre-existing .so is used only if make fails
+        if not _build() and not os.path.exists(_LIB_PATH):
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        i64, f32p, f64p, i64p, i32p = (
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+        )
+        lib.accumulate_f64.argtypes = [f64p, f32p, ctypes.c_double, i64]
+        lib.finalize_f64.argtypes = [f64p, ctypes.c_double, f32p, i64]
+        lib.topk_abs_threshold.restype = ctypes.c_float
+        lib.topk_abs_threshold.argtypes = [f32p, i64, i64]
+        lib.sparsify_topk.restype = i64
+        lib.sparsify_topk.argtypes = [f32p, i64, i64, i64p, f32p, ctypes.c_int]
+        lib.gather_rows_f32.argtypes = [f32p, i64, i64p, i64, f32p]
+        lib.gather_rows_i32.argtypes = [i32p, i64, i64p, i64, i32p]
+        lib.permute_indices.argtypes = [i64p, i64, ctypes.c_uint64]
+        lib.fastops_abi_version.restype = ctypes.c_int
+        assert lib.fastops_abi_version() == 1
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class Float64Accumulator:
+    """Streaming ``acc += x * w`` in float64, finalized to float32 — the
+    reference's server-side accumulation semantics, natively."""
+
+    def __init__(self, n: int) -> None:
+        self.acc = np.zeros(n, np.float64)
+        self.total_weight = 0.0
+        self.n = n
+
+    def add(self, x: np.ndarray, weight: float) -> None:
+        x = np.ascontiguousarray(x, np.float32).reshape(-1)
+        assert x.size == self.n
+        lib = _load()
+        if lib is not None:
+            lib.accumulate_f64(
+                _ptr(self.acc, ctypes.c_double),
+                _ptr(x, ctypes.c_float),
+                float(weight),
+                self.n,
+            )
+        else:
+            self.acc += x.astype(np.float64) * weight
+        self.total_weight += float(weight)
+
+    def finalize(self) -> np.ndarray:
+        assert self.total_weight > 0
+        out = np.empty(self.n, np.float32)
+        lib = _load()
+        if lib is not None:
+            lib.finalize_f64(
+                _ptr(self.acc, ctypes.c_double),
+                self.total_weight,
+                _ptr(out, ctypes.c_float),
+                self.n,
+            )
+        else:
+            out[:] = (self.acc / self.total_weight).astype(np.float32)
+        return out
+
+
+def topk_abs_threshold(x: np.ndarray, k: int) -> float:
+    x = np.ascontiguousarray(x, np.float32).reshape(-1)
+    lib = _load()
+    if lib is not None:
+        return float(lib.topk_abs_threshold(_ptr(x, ctypes.c_float), x.size, int(k)))
+    if k <= 0:
+        return float("inf")
+    k = min(k, x.size)
+    return float(np.partition(np.abs(x), x.size - k)[x.size - k])
+
+
+def sparsify(x: np.ndarray, k: int, zero_rest: bool = False):
+    """Keep the exact k largest-|x| entries (ties toward lower index);
+    returns (indices, values) in ascending index order.  With ``zero_rest``
+    the kept entries are zeroed **in x** (error-feedback: what is sent
+    leaves the residual)."""
+    x = np.ascontiguousarray(x, np.float32).reshape(-1)
+    k = min(int(k), x.size)
+    if k <= 0:
+        return np.empty(0, np.int64), np.empty(0, np.float32)
+    lib = _load()
+    if lib is not None:
+        indices = np.empty(k, np.int64)
+        values = np.empty(k, np.float32)
+        count = lib.sparsify_topk(
+            _ptr(x, ctypes.c_float),
+            x.size,
+            k,
+            _ptr(indices, ctypes.c_int64),
+            _ptr(values, ctypes.c_float),
+            1 if zero_rest else 0,
+        )
+        return indices[:count], values[:count]
+    # numpy fallback: argpartition on (-|x|, index) — same tie rule
+    order = np.lexsort((np.arange(x.size), -np.abs(x)))[:k]
+    indices = np.sort(order)
+    values = x[indices].copy()
+    if zero_rest:
+        x[indices] = 0.0
+    return indices, values
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """``src[idx]`` for 2D+ row-major arrays via one native memcpy pass."""
+    idx = np.ascontiguousarray(idx, np.int64)
+    lib = _load()
+    row_shape = src.shape[1:]
+    row_elems = int(np.prod(row_shape)) if row_shape else 1
+    if lib is None:
+        return src[idx]
+    if src.dtype == np.float32:
+        src_c = np.ascontiguousarray(src)
+        out = np.empty((idx.size, *row_shape), np.float32)
+        lib.gather_rows_f32(
+            _ptr(src_c, ctypes.c_float), row_elems,
+            _ptr(idx, ctypes.c_int64), idx.size,
+            _ptr(out, ctypes.c_float),
+        )
+        return out
+    if src.dtype == np.int32:
+        src_c = np.ascontiguousarray(src)
+        out = np.empty((idx.size, *row_shape), np.int32)
+        lib.gather_rows_i32(
+            _ptr(src_c, ctypes.c_int32), row_elems,
+            _ptr(idx, ctypes.c_int64), idx.size,
+            _ptr(out, ctypes.c_int32),
+        )
+        return out
+    return src[idx]
+
+
+def permute_indices(n: int, seed: int) -> np.ndarray:
+    """Deterministic permutation of ``arange(n)`` — identical across
+    platforms and library versions (xorshift64 Fisher-Yates)."""
+    idx = np.arange(n, dtype=np.int64)
+    lib = _load()
+    if lib is not None:
+        lib.permute_indices(_ptr(idx, ctypes.c_int64), n, seed & 0xFFFFFFFFFFFFFFFF)
+        return idx
+    rng = np.random.RandomState(seed & 0x7FFFFFFF)
+    rng.shuffle(idx)
+    return idx

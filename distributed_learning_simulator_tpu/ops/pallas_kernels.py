@@ -198,11 +198,14 @@ def weighted_accum(stacked: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
     ``[C, N]`` weighted temporary.  Returns float32 ``[N]``."""
     c, n = stacked.shape
     rows = max(8, ((math.ceil(n / LANE) + 7) // 8) * 8)
-    padded = jnp.zeros((c, rows * LANE), jnp.float32)
-    padded = padded.at[:, :n].set(stacked.astype(jnp.float32))
+    if n == rows * LANE:
+        padded = stacked.astype(jnp.float32)
+    else:
+        padded = jnp.zeros((c, rows * LANE), jnp.float32)
+        padded = padded.at[:, :n].set(stacked.astype(jnp.float32))
     x3d = padded.reshape(c, rows, LANE)
     blk = min(rows, 512)
-    grid = (rows // blk,) if rows % blk == 0 else (math.ceil(rows / blk),)
+    grid = (math.ceil(rows / blk),)
     out = pl.pallas_call(
         _weighted_accum_kernel,
         out_shape=jax.ShapeDtypeStruct((rows, LANE), jnp.float32),

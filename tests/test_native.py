@@ -1,0 +1,112 @@
+"""Native host runtime (C++ fastops via ctypes) vs numpy reference."""
+
+import numpy as np
+
+from distributed_learning_simulator_tpu import native
+
+
+def test_library_builds_and_loads():
+    assert native.available(), "g++ build of native/fastops.cc failed"
+
+
+def test_float64_accumulator_matches_numpy():
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(1000).astype(np.float32) for _ in range(5)]
+    ws = [1.0, 2.5, 0.5, 3.0, 1.25]
+    acc = native.Float64Accumulator(1000)
+    ref = np.zeros(1000, np.float64)
+    for x, w in zip(xs, ws):
+        acc.add(x, w)
+        ref += x.astype(np.float64) * w
+    out = acc.finalize()
+    expected = (ref / sum(ws)).astype(np.float32)
+    np.testing.assert_array_equal(out, expected)  # bit-identical
+
+
+def test_topk_threshold():
+    x = np.asarray([0.1, -5.0, 3.0, -0.2, 4.0], np.float32)
+    assert native.topk_abs_threshold(x, 2) == 4.0
+    assert native.topk_abs_threshold(x, 5) == np.float32(0.1)
+
+
+def test_sparsify_error_feedback():
+    x = np.asarray([0.1, -5.0, 3.0, -0.2, 4.0], np.float32)
+    residual = x.copy()
+    idx, vals = native.sparsify(residual, 2, zero_rest=True)
+    assert set(idx.tolist()) == {1, 4}
+    assert set(np.abs(vals).tolist()) == {5.0, 4.0}
+    # sent entries removed from residual, rest kept
+    assert residual[1] == 0.0 and residual[4] == 0.0
+    assert residual[0] == np.float32(0.1)
+
+
+def test_gather_rows():
+    rng = np.random.RandomState(1)
+    src = rng.randn(50, 3, 4).astype(np.float32)
+    idx = np.asarray([4, 0, 49, 7], np.int64)
+    np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+    tok = rng.randint(0, 100, (20, 16)).astype(np.int32)
+    np.testing.assert_array_equal(native.gather_rows(tok, idx[:2]), tok[idx[:2]])
+
+
+def test_permute_deterministic():
+    a = native.permute_indices(1000, seed=42)
+    b = native.permute_indices(1000, seed=42)
+    c = native.permute_indices(1000, seed=43)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    assert sorted(a.tolist()) == list(range(1000))
+
+
+def test_float64_parity_fed_avg_e2e():
+    """fed_avg with algorithm_kwargs.float64_parity routes aggregation
+    through the native float64 accumulator and still converges."""
+    from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+    from distributed_learning_simulator_tpu.training import train
+
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        distributed_algorithm="fed_avg",
+        worker_number=2,
+        batch_size=16,
+        round=1,
+        epoch=1,
+        learning_rate=0.05,
+        algorithm_kwargs={"float64_parity": True},
+        dataset_kwargs={"train_size": 64, "val_size": 16, "test_size": 32},
+    )
+    result = train(config)
+    assert result["performance"], "no round stats recorded"
+
+
+def test_smafd_topk_e2e():
+    from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+    from distributed_learning_simulator_tpu.training import train
+
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        distributed_algorithm="single_model_afd",
+        worker_number=2,
+        batch_size=16,
+        round=2,
+        epoch=1,
+        learning_rate=0.05,
+        algorithm_kwargs={"dropout_rate": 0.3, "topk_ratio": 0.1},
+        dataset_kwargs={"train_size": 64, "val_size": 16, "test_size": 32},
+    )
+    result = train(config)
+    assert result["performance"], "no round stats recorded"
+
+
+def test_sparsify_exact_topk_with_zeros():
+    """Regression: fewer nonzeros than k must still select the large values
+    (threshold-scan bug: first-k zeros displaced them)."""
+    x = np.zeros(100, np.float32)
+    x[90] = 5.0
+    x[7] = -2.0
+    idx, vals = native.sparsify(x.copy(), 10)
+    assert 90 in idx.tolist() and 7 in idx.tolist()
+    kept = dict(zip(idx.tolist(), vals.tolist()))
+    assert kept[90] == 5.0 and kept[7] == -2.0
