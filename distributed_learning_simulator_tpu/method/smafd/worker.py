@@ -29,24 +29,22 @@ class SingleModelAFDWorker(ErrorFeedbackWorker):
                 seed=self.config.seed * 31 + self.worker_id,
             )
 
-    def _topk_sparsify(self, delta: Params) -> Params:
+    def _topk_sparsify(self, delta: Params) -> tuple[Params, int]:
         sent: Params = {}
+        send_num = 0
         for name, value in delta.items():
             flat = np.asarray(value, np.float32).reshape(-1)
             k = max(1, int(flat.size * self._topk_ratio))
             indices, values = sparsify(flat, k)
+            send_num += len(indices)
             dense = np.zeros_like(flat)
             dense[indices] = values
             sent[name] = jnp.asarray(dense.reshape(np.shape(value)))
-        return sent
+        return sent, send_num
 
     def _sparsify(self, delta: Params) -> Params:
         if self._topk_ratio is not None:
-            sent = self._topk_sparsify(delta)
-            send_num = sum(
-                max(1, int(np.asarray(v).size * self._topk_ratio))
-                for v in delta.values()
-            )
+            sent, send_num = self._topk_sparsify(delta)
         else:
             sent = self._dropout.drop_parameters(delta)
             send_num = sum(int(v.size) for v in sent.values())

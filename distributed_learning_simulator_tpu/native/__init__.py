@@ -9,11 +9,12 @@ Surface:
 * :class:`Float64Accumulator` — streaming float64 parameter aggregation,
   the reference server's accumulation semantics
   (``simulation_lib/algorithm/fed_avg_algorithm.py:44``) for bit-parity runs;
-* :func:`topk_abs_threshold` / :func:`sparsify` — error-feedback top-k
-  sparsification (``single_model_afd``);
+* :func:`sparsify` — exact top-k error-feedback sparsification
+  (``single_model_afd`` with ``topk_ratio``);
 * :func:`gather_rows` — fused index-select batch assembly for the host
   input pipeline;
-* :func:`permute_indices` — version-stable deterministic shuffling.
+* :func:`permute_indices` — version-stable deterministic shuffling (the
+  IID sampler's per-class permutation).
 """
 
 import ctypes
@@ -62,27 +63,27 @@ def _load():
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
+            i64, f32p, f64p, i64p, i32p = (
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32),
+            )
+            lib.accumulate_f64.argtypes = [f64p, f32p, ctypes.c_double, i64]
+            lib.finalize_f64.argtypes = [f64p, ctypes.c_double, f32p, i64]
+            lib.sparsify_topk.restype = i64
+            lib.sparsify_topk.argtypes = [f32p, i64, i64, i64p, f32p, ctypes.c_int]
+            lib.gather_rows_f32.argtypes = [f32p, i64, i64p, i64, f32p]
+            lib.gather_rows_i32.argtypes = [i32p, i64, i64p, i64, i32p]
+            lib.permute_indices.argtypes = [i64p, i64, ctypes.c_uint64]
+            lib.fastops_abi_version.restype = ctypes.c_int
+            if lib.fastops_abi_version() != 1:
+                raise OSError("fastops ABI mismatch")
+        except (OSError, AttributeError):
+            # stale/incompatible binary: fall back to numpy everywhere
             _build_failed = True
             return None
-        i64, f32p, f64p, i64p, i32p = (
-            ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_float),
-            ctypes.POINTER(ctypes.c_double),
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int32),
-        )
-        lib.accumulate_f64.argtypes = [f64p, f32p, ctypes.c_double, i64]
-        lib.finalize_f64.argtypes = [f64p, ctypes.c_double, f32p, i64]
-        lib.topk_abs_threshold.restype = ctypes.c_float
-        lib.topk_abs_threshold.argtypes = [f32p, i64, i64]
-        lib.sparsify_topk.restype = i64
-        lib.sparsify_topk.argtypes = [f32p, i64, i64, i64p, f32p, ctypes.c_int]
-        lib.gather_rows_f32.argtypes = [f32p, i64, i64p, i64, f32p]
-        lib.gather_rows_i32.argtypes = [i32p, i64, i64p, i64, i32p]
-        lib.permute_indices.argtypes = [i64p, i64, ctypes.c_uint64]
-        lib.fastops_abi_version.restype = ctypes.c_int
-        assert lib.fastops_abi_version() == 1
         _lib = lib
         return _lib
 
@@ -135,22 +136,18 @@ class Float64Accumulator:
         return out
 
 
-def topk_abs_threshold(x: np.ndarray, k: int) -> float:
-    x = np.ascontiguousarray(x, np.float32).reshape(-1)
-    lib = _load()
-    if lib is not None:
-        return float(lib.topk_abs_threshold(_ptr(x, ctypes.c_float), x.size, int(k)))
-    if k <= 0:
-        return float("inf")
-    k = min(k, x.size)
-    return float(np.partition(np.abs(x), x.size - k)[x.size - k])
-
-
 def sparsify(x: np.ndarray, k: int, zero_rest: bool = False):
     """Keep the exact k largest-|x| entries (ties toward lower index);
     returns (indices, values) in ascending index order.  With ``zero_rest``
     the kept entries are zeroed **in x** (error-feedback: what is sent
-    leaves the residual)."""
+    leaves the residual) — ``x`` must then be contiguous float32, or the
+    mutation would land on a temporary copy."""
+    if zero_rest:
+        assert (
+            isinstance(x, np.ndarray)
+            and x.dtype == np.float32
+            and x.flags.c_contiguous
+        ), "zero_rest requires a contiguous float32 array (mutated in place)"
     x = np.ascontiguousarray(x, np.float32).reshape(-1)
     k = min(int(k), x.size)
     if k <= 0:

@@ -52,15 +52,32 @@ def _unpack_uint(packed: jnp.ndarray, bits: int, n: int) -> jnp.ndarray:
 
 
 # ------------------------------------------------------- stochastic (QSGD)
-@functools.partial(jax.jit, static_argnums=(2, 3))
-def _sq_encode_leaf(x: jnp.ndarray, key: jax.Array, level: int, bits: int):
-    flat = x.astype(jnp.float32).reshape(-1)
+def _sq_levels(flat: jnp.ndarray, key: jax.Array, level: int):
+    """The QSGD numerics shared by every executor path: abs-max scale +
+    stochastic rounding to ``level`` magnitude levels."""
     scale = jnp.maximum(jnp.max(jnp.abs(flat)), 1e-12)
     normalized = jnp.abs(flat) / scale * level
     floor = jnp.floor(normalized)
     prob = normalized - floor
     rnd = jax.random.uniform(key, flat.shape)
     q = floor + (rnd < prob).astype(jnp.float32)  # stochastic rounding
+    return q, scale
+
+
+def qsgd_quantize_dequantize(x: jnp.ndarray, key: jax.Array, level: int) -> jnp.ndarray:
+    """Quantize→dequantize in one step — the transport numerics without the
+    byte packing.  Used by the SPMD fed_paq round program, where 'transport'
+    is an ICI collective and only the value distortion matters."""
+    flat = x.astype(jnp.float32).reshape(-1)
+    q, scale = _sq_levels(flat, key, level)
+    out = jnp.sign(flat) * q / level * scale
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _sq_encode_leaf(x: jnp.ndarray, key: jax.Array, level: int, bits: int):
+    flat = x.astype(jnp.float32).reshape(-1)
+    q, scale = _sq_levels(flat, key, level)
     sign_bits = (flat < 0).astype(jnp.uint32)
     packed = _pack_uint(q.astype(jnp.uint32), bits)
     packed_signs = _pack_uint(sign_bits, 1)

@@ -36,11 +36,75 @@ from ..utils.logging import get_logger
 from .mesh import client_slots, make_mesh
 
 
+def stack_client_data(config, dataset_collection, practitioners, n_slots):
+    """Stack per-client training data to ``[C, n_batches, B, ...]`` with
+    zero-weight padding slots; returns (data dict, dataset_sizes, n_batches)."""
+    train = dataset_collection.get_dataset(Phase.Training)
+    batch_size = config.batch_size
+    sizes = []
+    per_client_indices = []
+    for practitioner in sorted(practitioners, key=lambda p: p.worker_id):
+        sampler = practitioner.get_sampler(config.dataset_name)
+        idx = sampler.sample(practitioner.practitioner_id)[Phase.Training]
+        per_client_indices.append(idx)
+        sizes.append(len(idx))
+    max_size = max(sizes)
+    n_batches = max(1, (max_size + batch_size - 1) // batch_size)
+    slot_size = n_batches * batch_size
+
+    inputs, targets, masks = [], [], []
+    for idx in per_client_indices:
+        padded, mask = fixed_size_partition(idx, slot_size)
+        inputs.append(train.inputs[padded])
+        targets.append(train.targets[padded])
+        masks.append(mask)
+    while len(inputs) < n_slots:  # zero-weight padding slots
+        inputs.append(np.zeros_like(inputs[0]))
+        targets.append(np.zeros_like(targets[0]))
+        masks.append(np.zeros_like(masks[0]))
+
+    def stack(parts, extra_shape):
+        return np.stack(parts).reshape(n_slots, n_batches, batch_size, *extra_shape)
+
+    data = {
+        "input": stack(inputs, train.inputs.shape[1:]),
+        "target": stack(targets, ()),
+        "mask": stack(masks, ()),
+    }
+    dataset_sizes = np.asarray(sizes + [0] * (n_slots - len(sizes)), np.float32)
+    return data, dataset_sizes, n_batches
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map
+
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
+
+
+# in-program QSGD for quantized-upload methods on the SPMD path: on ICI
+# there is no byte stream to pack — aggregation must just see the same
+# dequantized levels the reference server would (``fed_paq`` = FedAvg +
+# StochasticQuant endpoints, ``method/fed_paq/__init__.py:7-14``); the
+# numerics live in ops/quantization.py, shared with the threaded codec
+from ..ops.quantization import qsgd_quantize_dequantize as qsgd_dequantized
+
+
 class SpmdFedAvgSession:
     """FedAvg-family rounds as single SPMD programs.
 
     Supported method semantics: fed_avg (full/delta uploads are equivalent
-    under full participation averaging) with random client selection.
+    under full participation averaging) with random client selection, and
+    fed_paq (``quantization_level`` set: client uploads pass through QSGD
+    quantize→dequantize before the weighted psum).
     """
 
     def __init__(
@@ -51,6 +115,7 @@ class SpmdFedAvgSession:
         engine: ComputeEngine,
         practitioners,
         mesh: Mesh | None = None,
+        quantization_level: int | None = None,
     ) -> None:
         self.config = config
         self.dc = dataset_collection
@@ -58,49 +123,13 @@ class SpmdFedAvgSession:
         self.engine = engine
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_slots = client_slots(config.worker_number, self.mesh)
+        self.quantization_level = quantization_level
         self._stat: dict[int, dict] = {}
         self._max_acc = 0.0
 
-        # ---- stack per-client data [C, n_batches, B, ...] ----
-        train = dataset_collection.get_dataset(Phase.Training)
-        batch_size = config.batch_size
-        sizes = []
-        per_client_indices = []
-        for practitioner in sorted(practitioners, key=lambda p: p.worker_id):
-            sampler = practitioner.get_sampler(config.dataset_name)
-            idx = sampler.sample(practitioner.practitioner_id)[Phase.Training]
-            per_client_indices.append(idx)
-            sizes.append(len(idx))
-        max_size = max(sizes)
-        n_batches = max(1, (max_size + batch_size - 1) // batch_size)
-        slot_size = n_batches * batch_size
-
-        inputs, targets, masks = [], [], []
-        for idx in per_client_indices:
-            padded, mask = fixed_size_partition(idx, slot_size)
-            inputs.append(train.inputs[padded])
-            targets.append(train.targets[padded])
-            masks.append(mask)
-        while len(inputs) < self.n_slots:  # zero-weight padding slots
-            inputs.append(np.zeros_like(inputs[0]))
-            targets.append(np.zeros_like(targets[0]))
-            masks.append(np.zeros_like(masks[0]))
-
-        def stack(parts, extra_shape):
-            arr = np.stack(parts).reshape(
-                self.n_slots, n_batches, batch_size, *extra_shape
-            )
-            return arr
-
-        self._data = {
-            "input": stack(inputs, train.inputs.shape[1:]),
-            "target": stack(targets, ()),
-            "mask": stack(masks, ()),
-        }
-        self._dataset_sizes = np.asarray(
-            sizes + [0] * (self.n_slots - len(sizes)), np.float32
+        self._data, self._dataset_sizes, self.n_batches = stack_client_data(
+            config, dataset_collection, practitioners, self.n_slots
         )
-        self.n_batches = n_batches
 
         # ---- shardings ----
         self._client_sharding = NamedSharding(self.mesh, P("clients"))
@@ -116,7 +145,7 @@ class SpmdFedAvgSession:
     def _build_round_fn(self):
         engine = self.engine
         epochs = self.config.epoch
-        n_slots_local = self.n_slots // self.mesh.shape["clients"]
+        quant_level = self.quantization_level
 
         def local_train(global_params, data, weight, rng):
             """One client slot: E epochs of minibatch SGD from the fresh
@@ -132,11 +161,23 @@ class SpmdFedAvgSession:
                 )
                 return (params, opt_state), metrics
 
+            rng, quant_rng = jax.random.split(rng)
             epoch_rngs = jax.random.split(rng, epochs)
             (params, opt_state), metrics = jax.lax.scan(
                 epoch_body, (params, opt_state), epoch_rngs
             )
             summed = jax.tree.map(lambda x: jnp.sum(x), metrics)
+            if quant_level is not None:
+                # fed_paq: the upload delta goes through the stochastic
+                # codec before aggregation sees it
+                leaves, treedef = jax.tree.flatten(params)
+                g_leaves = jax.tree.leaves(global_params)
+                keys = jax.random.split(quant_rng, len(leaves))
+                leaves = [
+                    g + qsgd_dequantized(p - g, k, quant_level)
+                    for p, g, k in zip(leaves, g_leaves, keys)
+                ]
+                params = jax.tree.unflatten(treedef, leaves)
             # weighted contribution; unselected slots contribute zero
             contribution = jax.tree.map(
                 lambda p: p.astype(jnp.float32) * weight, params
@@ -167,21 +208,11 @@ class SpmdFedAvgSession:
                 )
                 return new_global, metrics
 
-            try:
-                from jax import shard_map
-
-                compat = {"check_vma": False}
-            except ImportError:  # older jax
-                from jax.experimental.shard_map import shard_map
-
-                compat = {"check_rep": False}
-
-            return shard_map(
+            return shard_map_compat(
                 shard_body,
-                mesh=self.mesh,
+                self.mesh,
                 in_specs=(P(), P("clients"), P("clients"), P("clients")),
                 out_specs=(P(), P()),
-                **compat,
             )(global_params, self._data, weights, rngs)
 
         return jax.jit(round_program)
@@ -252,6 +283,179 @@ class SpmdFedAvgSession:
                 os.path.join(save_dir, "best_global_model.npz"),
                 **{k: np.asarray(v) for k, v in global_params.items()},
             )
+
+    @property
+    def performance_stat(self) -> dict:
+        return self._stat
+
+
+class SpmdSignSGDSession:
+    """The whole sign-SGD run as ONE SPMD program.
+
+    The reference's sign-SGD substrate exchanges a gradient through pipes
+    on **every optimizer step** (``worker/gradient_worker.py:50-116`` — the
+    worst-case transport pattern for the pipe fabric).  Here the per-step
+    exchange is a ``psum`` over the ``clients`` mesh axis *inside* the
+    scanned step body: sign(local grad) → masked sum across slots → psum →
+    sign (majority vote, ``method/sign_sgd``) → momentum SGD update applied
+    identically on every client.  No host round-trips at all — epochs ×
+    batches × collectives compile into a single XLA program.
+    """
+
+    def __init__(
+        self,
+        config: DistributedTrainingConfig,
+        dataset_collection,
+        model_ctx,
+        engine: ComputeEngine,
+        practitioners,
+        mesh: Mesh | None = None,
+    ) -> None:
+        self.config = config
+        self.dc = dataset_collection
+        self.model_ctx = model_ctx
+        self.engine = engine
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_slots = client_slots(config.worker_number, self.mesh)
+        self._stat: dict[int, dict] = {}
+
+        self._data, self._dataset_sizes, self.n_batches = stack_client_data(
+            config, dataset_collection, practitioners, self.n_slots
+        )
+        self._client_sharding = NamedSharding(self.mesh, P("clients"))
+        self._replicated = NamedSharding(self.mesh, P())
+        # scan wants batch-major: [n_batches, C, B, ...]
+        self._data = jax.device_put(
+            {k: np.swapaxes(v, 0, 1) for k, v in self._data.items()},
+            NamedSharding(self.mesh, P(None, "clients")),
+        )
+        self._run_fn = self._build_run_fn()
+
+    def _build_run_fn(self):
+        engine = self.engine
+        epochs = self.config.epoch
+        n_batches = self.n_batches
+        hp = engine.hyper_parameter
+        momentum = hp.momentum
+        schedule = hp.make_schedule(epochs * n_batches)
+
+        def shard_body(params, data, weights, rngs):
+            # data: [n_batches, slots_local, B, ...]; weights/rngs: [slots_local(, 2)]
+            velocity = jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+
+            def batch_body(carry, batch):
+                params, velocity, step = carry
+
+                def grad_one(batch_slot, rng):
+                    (loss, aux), grads = engine.loss_and_grad(
+                        params, batch_slot, jax.random.fold_in(rng, step)
+                    )
+                    metrics = {
+                        "loss_sum": loss * aux["count"],
+                        "correct": aux["correct"],
+                        "count": aux["count"],
+                    }
+                    return grads, metrics
+
+                grads, metrics = jax.vmap(grad_one)(batch, rngs)
+                # majority vote: sign of the sum of signs, padding slots
+                # masked out (weights ∈ {0, 1})
+                total = jax.tree.map(
+                    lambda g: jax.lax.psum(
+                        jnp.einsum("c,c...->...", weights, jnp.sign(g)),
+                        axis_name="clients",
+                    ),
+                    grads,
+                )
+                direction = jax.tree.map(jnp.sign, total)
+                velocity = jax.tree.map(
+                    lambda v, d: momentum * v + d, velocity, direction
+                )
+                lr = schedule(step)
+                params = jax.tree.map(
+                    lambda p, v: (p.astype(jnp.float32) - lr * v).astype(p.dtype),
+                    params,
+                    velocity,
+                )
+                metrics = jax.tree.map(
+                    lambda m: jax.lax.psum(jnp.sum(m, axis=0), axis_name="clients"),
+                    metrics,
+                )
+                return (params, velocity, step + 1), metrics
+
+            def epoch_body(carry, _):
+                carry, metrics = jax.lax.scan(batch_body, carry, data)
+                return carry, jax.tree.map(lambda m: jnp.sum(m), metrics)
+
+            (params, _, _), epoch_metrics = jax.lax.scan(
+                epoch_body, (params, velocity, jnp.int32(0)), None, length=epochs
+            )
+            return params, epoch_metrics
+
+        def run_program(params, weights, rngs):
+            return shard_map_compat(
+                shard_body,
+                self.mesh,
+                in_specs=(P(), P(None, "clients"), P("clients"), P("clients")),
+                out_specs=(P(), P()),
+            )(params, self._data, weights, rngs)
+
+        return jax.jit(run_program)
+
+    def run(self) -> dict:
+        config = self.config
+        params = jax.device_put(
+            self.engine.init_params(config.seed), self._replicated
+        )
+        weights = jax.device_put(
+            (self._dataset_sizes > 0).astype(np.float32), self._client_sharding
+        )
+        save_dir = os.path.join(config.save_dir, "server")
+        os.makedirs(save_dir, exist_ok=True)
+        from ..engine.batching import make_epoch_batches
+
+        test = self.dc.get_dataset(Phase.Test)
+        batches = make_epoch_batches(test, config.batch_size)
+        best_acc = -1.0
+        for round_number in range(1, config.round + 1):
+            rngs = jax.device_put(
+                jax.random.split(
+                    jax.random.PRNGKey(config.seed + round_number), self.n_slots
+                ),
+                self._client_sharding,
+            )
+            params, epoch_metrics = self._run_fn(params, weights, rngs)
+            metric = summarize_metrics(self.engine.evaluate(params, batches))
+            count = np.maximum(np.asarray(epoch_metrics["count"]), 1.0)
+            self._stat[round_number] = {
+                "test_accuracy": metric["accuracy"],
+                "test_loss": metric["loss"],
+                "test_count": metric["count"],
+                "train_loss_per_epoch": (
+                    np.asarray(epoch_metrics["loss_sum"]) / count
+                ).tolist(),
+                "train_accuracy_per_epoch": (
+                    np.asarray(epoch_metrics["correct"]) / count
+                ).tolist(),
+            }
+            get_logger().info(
+                "round: %d, sign_SGD (spmd) %d steps, test accuracy %.4f loss %.4f",
+                round_number,
+                config.epoch * self.n_batches,
+                metric["accuracy"],
+                metric["loss"],
+            )
+            with open(
+                os.path.join(save_dir, "round_record.json"), "wt", encoding="utf8"
+            ) as f:
+                json.dump(self._stat, f)
+            if metric["accuracy"] > best_acc:
+                best_acc = metric["accuracy"]
+                np.savez(
+                    os.path.join(save_dir, "best_global_model.npz"),
+                    **{k: np.asarray(v) for k, v in params.items()},
+                )
+        return {"performance": self._stat}
 
     @property
     def performance_stat(self) -> dict:

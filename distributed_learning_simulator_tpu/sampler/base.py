@@ -86,11 +86,18 @@ class IIDSampler(DatasetCollectionSampler):
     every class (reference default ``dataset_sampling: iid``)."""
 
     def _split_indices(self, indices, targets, phase):
-        rng = np.random.default_rng(self.seed * 1009 + _phase_salt(phase))
+        # native xorshift permutation: deterministic across platforms AND
+        # numpy versions (Generator streams carry no such guarantee)
+        from ..native import permute_indices
+
         parts: list[list[np.ndarray]] = [[] for _ in range(self.part_number)]
         for label in np.unique(targets):
             label_idx = indices[targets == label]
-            label_idx = rng.permutation(label_idx)
+            perm = permute_indices(
+                len(label_idx),
+                seed=self.seed * 1009 + _phase_salt(phase) * 131 + int(label),
+            )
+            label_idx = label_idx[perm]
             for i, chunk in enumerate(np.array_split(label_idx, self.part_number)):
                 parts[i].append(chunk)
         return [np.concatenate(p) if p else np.array([], dtype=np.int64) for p in parts]

@@ -185,18 +185,32 @@ def train(
     task_id = uuid.uuid4() if return_task_id else None
     ctx = _build_task(config, practitioners=practitioners, task_id=task_id)
     if ctx.config.executor == "spmd":
-        assert (
-            ctx.config.distributed_algorithm == "fed_avg"
-        ), "the SPMD fast path currently implements the fed_avg round program"
-        from .parallel.spmd import SpmdFedAvgSession
+        algo = ctx.config.distributed_algorithm
+        from .parallel.spmd import SpmdFedAvgSession, SpmdSignSGDSession
 
-        session = SpmdFedAvgSession(
+        session_args = (
             ctx.config,
             ctx.dataset_collection,
             ctx.model_ctx,
             ctx.engine,
             ctx.practitioners,
         )
+        if algo == "fed_avg":
+            session = SpmdFedAvgSession(*session_args)
+        elif algo == "fed_paq":
+            level = int(
+                ctx.config.endpoint_kwargs.get("worker", {}).get(
+                    "quantization_level", 255
+                )
+            )
+            session = SpmdFedAvgSession(*session_args, quantization_level=level)
+        elif algo == "sign_SGD":
+            session = SpmdSignSGDSession(*session_args)
+        else:
+            raise NotImplementedError(
+                f"no SPMD round program for {algo!r}; supported: "
+                "fed_avg, fed_paq, sign_SGD (use the threaded executor)"
+            )
         result = session.run()
         get_logger().info("training took %.2f seconds", ctx.timer.elapsed_seconds())
         if return_task_id:

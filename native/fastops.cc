@@ -39,17 +39,6 @@ void finalize_f64(const double* acc, double total_w, float* out, int64_t n) {
 }
 
 // ------------------------------------------------------------------- top-k
-// Return the k-th largest |x| (the keep-threshold for sparsification).
-float topk_abs_threshold(const float* x, int64_t n, int64_t k) {
-  if (k <= 0) return HUGE_VALF;
-  if (k > n) k = n;
-  std::vector<float> mag(n);
-  for (int64_t i = 0; i < n; ++i) mag[i] = std::fabs(x[i]);
-  std::nth_element(mag.begin(), mag.begin() + (k - 1), mag.end(),
-                   std::greater<float>());
-  return mag[k - 1];
-}
-
 // Exact top-k by |x| (ties broken toward lower index) into (indices,
 // values), emitted in ascending index order. If zero_rest != 0 the selected
 // entries are zeroed IN x (error-feedback residual update: what is sent

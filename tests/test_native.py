@@ -23,10 +23,11 @@ def test_float64_accumulator_matches_numpy():
     np.testing.assert_array_equal(out, expected)  # bit-identical
 
 
-def test_topk_threshold():
+def test_sparsify_topk_selection():
     x = np.asarray([0.1, -5.0, 3.0, -0.2, 4.0], np.float32)
-    assert native.topk_abs_threshold(x, 2) == 4.0
-    assert native.topk_abs_threshold(x, 5) == np.float32(0.1)
+    idx, vals = native.sparsify(x.copy(), 2)
+    assert idx.tolist() == [1, 4]
+    assert vals.tolist() == [-5.0, 4.0]
 
 
 def test_sparsify_error_feedback():

@@ -1,0 +1,60 @@
+"""SPMD fast paths for fed_paq and sign_SGD (virtual 8-device mesh)."""
+
+import numpy as np
+
+from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+from distributed_learning_simulator_tpu.training import train
+
+
+def _config(**kwargs):
+    base = dict(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        worker_number=8,
+        batch_size=16,
+        round=2,
+        epoch=1,
+        learning_rate=0.05,
+        executor="spmd",
+        dataset_kwargs={"train_size": 256, "val_size": 32, "test_size": 64},
+    )
+    base.update(kwargs)
+    return DistributedTrainingConfig(**base)
+
+
+def test_spmd_fed_paq():
+    config = _config(
+        distributed_algorithm="fed_paq",
+        endpoint_kwargs={"worker": {"quantization_level": 255}},
+    )
+    result = train(config)
+    assert len(result["performance"]) == 2
+    for stat in result["performance"].values():
+        assert np.isfinite(stat["test_loss"])
+
+
+def test_spmd_fed_paq_matches_fed_avg_closely():
+    """255-level quantization perturbs uploads only slightly: one round from
+    the same init should land near the unquantized result."""
+    r_avg = train(_config(distributed_algorithm="fed_avg", round=1))
+    r_paq = train(
+        _config(
+            distributed_algorithm="fed_paq",
+            round=1,
+            endpoint_kwargs={"worker": {"quantization_level": 255}},
+        )
+    )
+    a = r_avg["performance"][1]["test_loss"]
+    b = r_paq["performance"][1]["test_loss"]
+    assert abs(a - b) < 0.1 * max(abs(a), 1e-6)
+
+
+def test_spmd_sign_sgd():
+    config = _config(distributed_algorithm="sign_SGD", epoch=3, round=2)
+    result = train(config)
+    assert len(result["performance"]) == 2
+    stat = result["performance"][1]
+    assert np.isfinite(stat["test_loss"])
+    assert len(stat["train_loss_per_epoch"]) == 3
+    # training loss should not diverge over epochs
+    assert stat["train_loss_per_epoch"][-1] <= stat["train_loss_per_epoch"][0] * 1.5
