@@ -12,5 +12,6 @@ from . import sign_sgd  # noqa: F401
 from . import smafd  # noqa: F401
 from . import shapley_value  # noqa: F401
 from . import fed_gnn  # noqa: F401
+from . import fed_aas  # noqa: F401
 
 __all__ = ["CentralizedAlgorithmFactory"]

@@ -1,0 +1,73 @@
+"""fed_aas: subgraph federated learning with per-round neighbor sampling.
+
+The reference ships configs for this method (``conf/fed_aas/*.yaml``:
+GCN models, ``share_feature: false``, aggressive ``edge_drop_rate``,
+``num_neighbor`` fan-in caps) but its registration was removed from the
+snapshot (SURVEY.md §2.9 "configs with no registration").  Re-created here
+from the config surface: a :class:`GraphWorker` that trains on its local
+subgraph only (no boundary-embedding exchange) and, when ``num_neighbor``
+is set (``algorithm_kwargs`` or ``extra_hyper_parameters``), resamples a
+bounded-fan-in edge subset every round (GraphSAGE-style neighbor sampling,
+the reference's ``num_neighbor`` dataloader kwarg,
+``simulation_lib/worker/graph_worker.py:98-101``).
+"""
+
+import numpy as np
+
+from ...server.graph_server import GraphNodeServer
+from ...utils.logging import get_logger
+from ...worker.graph_worker import GraphWorker
+from ..algorithm_factory import CentralizedAlgorithmFactory
+
+
+class FedAASWorker(GraphWorker):
+    def __init__(self, **kwargs) -> None:
+        super().__init__(**kwargs)
+        # local-subgraph training: never exchange boundary embeddings
+        self._share_feature = False
+        self._num_neighbor = self.config.algorithm_kwargs.get(
+            "num_neighbor",
+            self.config.extra_hyper_parameters.get("num_neighbor"),
+        )
+
+    def _before_round(self) -> None:
+        super()._before_round()
+        if self._num_neighbor is None:
+            return
+        graph = self.training_dataset.inputs
+        edge_index = graph["edge_index"]
+        dst = edge_index[1]
+        base = self._local_edge_mask.astype(bool)
+        rng = np.random.default_rng(
+            self.config.seed * 1013 + self.worker_id * 97 + self._round_num
+        )
+        # cap incoming fan-in per destination at num_neighbor, resampled
+        # each round: random permutation, stable-sort by destination, keep
+        # rank-within-destination < limit (vectorized — edge lists are large)
+        candidates = rng.permutation(np.nonzero(base)[0])
+        limit = int(self._num_neighbor)
+        keep = np.zeros_like(base)
+        if len(candidates):
+            d = dst[candidates]
+            by_dst = np.argsort(d, kind="stable")
+            sorted_d = d[by_dst]
+            n_sorted = len(sorted_d)
+            first_idx = np.r_[0, np.nonzero(np.diff(sorted_d))[0] + 1]
+            group_id = np.cumsum(np.r_[0, (np.diff(sorted_d) != 0).astype(np.int64)])
+            rank = np.arange(n_sorted) - first_idx[group_id]
+            keep[candidates[by_dst[rank < limit]]] = True
+        graph["edge_mask"] = keep.astype(np.float32)
+        get_logger().debug(
+            "%s round %d: neighbor sampling kept %d/%d local edges",
+            self.name,
+            self._round_num,
+            int(keep.sum()),
+            int(base.sum()),
+        )
+
+
+CentralizedAlgorithmFactory.register_algorithm(
+    algorithm_name="fed_aas",
+    client_cls=FedAASWorker,
+    server_cls=GraphNodeServer,
+)

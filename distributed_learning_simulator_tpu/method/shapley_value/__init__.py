@@ -3,7 +3,11 @@
 
 from ...worker.aggregation_worker import AggregationWorker
 from ..algorithm_factory import CentralizedAlgorithmFactory
-from .servers import GTGShapleyValueServer, MultiRoundShapleyValueServer
+from .servers import (
+    GTGShapleyValueServer,
+    HierarchicalShapleyValueServer,
+    MultiRoundShapleyValueServer,
+)
 
 CentralizedAlgorithmFactory.register_algorithm(
     algorithm_name="multiround_shapley_value",
@@ -14,4 +18,9 @@ CentralizedAlgorithmFactory.register_algorithm(
     algorithm_name="GTG_shapley_value",
     client_cls=AggregationWorker,
     server_cls=GTGShapleyValueServer,
+)
+CentralizedAlgorithmFactory.register_algorithm(
+    algorithm_name="Hierarchical_shapley_value",
+    client_cls=AggregationWorker,
+    server_cls=HierarchicalShapleyValueServer,
 )

@@ -6,6 +6,7 @@ from typing import Any
 
 from ...server.aggregation_server import AggregationServer
 from ...shapley.gtg_shapley_value import GTGShapleyValue
+from ...shapley.hierarchical_shapley_value import HierarchicalShapleyValue
 from ...shapley.multiround_shapley_value import MultiRoundShapleyValue
 from .shapley_value_algorithm import ShapleyValueAlgorithm
 
@@ -26,6 +27,21 @@ class MultiRoundShapleyValueAlgorithm(ShapleyValueAlgorithm):
         super().__init__(MultiRoundShapleyValue, *args, **kwargs)
 
 
+class HierarchicalShapleyValueAlgorithm(ShapleyValueAlgorithm):
+    """Two-level SV over worker groups (``conf/hierarchical_sv/mnist.yaml``:
+    ``part_number``, ``vp_size`` live directly in ``algorithm_kwargs``)."""
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(HierarchicalShapleyValue, *args, **kwargs)
+
+    def _sv_engine_kwargs(self) -> dict:
+        kwargs = super()._sv_engine_kwargs()
+        for key in ("part_number", "vp_size"):
+            if key in self.config.algorithm_kwargs:
+                kwargs[key] = self.config.algorithm_kwargs[key]
+        return kwargs
+
+
 class GTGShapleyValueServer(ShapleyValueServer):
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs, algorithm=GTGShapleyValueAlgorithm(server=self))
@@ -35,4 +51,11 @@ class MultiRoundShapleyValueServer(ShapleyValueServer):
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(
             **kwargs, algorithm=MultiRoundShapleyValueAlgorithm(server=self)
+        )
+
+
+class HierarchicalShapleyValueServer(ShapleyValueServer):
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(
+            **kwargs, algorithm=HierarchicalShapleyValueAlgorithm(server=self)
         )

@@ -42,6 +42,11 @@ class ShapleyValueAlgorithm(FedAVGAlgorithm):
     def _get_players(self):
         return sorted(self._all_worker_data.keys())
 
+    def _sv_engine_kwargs(self) -> dict:
+        """Engine ctor kwargs beyond (players, last_round_metric);
+        subclasses add their config surface (e.g. hierarchical grouping)."""
+        return dict(self.config.algorithm_kwargs.get("sv_kwargs", {}))
+
     def aggregate_worker_data(self) -> Message:
         if self.sv_algorithm is None:
             assert self._server.round_number == 1
@@ -50,7 +55,7 @@ class ShapleyValueAlgorithm(FedAVGAlgorithm):
                 last_round_metric=self._server.performance_stat[
                     self._server.round_number - 1
                 ][f"test_{self.metric_type}"],
-                **self.config.algorithm_kwargs.get("sv_kwargs", {}),
+                **self._sv_engine_kwargs(),
             )
         self.sv_algorithm.set_metric_function(self._get_subset_metric)
         self.sv_algorithm.compute(round_number=self._server.round_number)

@@ -12,6 +12,42 @@ engine itself is pure host logic with per-round subset-metric caching.
 from collections.abc import Callable, Iterable
 
 
+def exact_shapley(players: list, metric: Callable[[set], float]) -> dict:
+    """Textbook exact SV (≤ ~12 players) with a cached metric callable."""
+    import itertools
+    import math
+
+    n = len(players)
+    sv = {p: 0.0 for p in players}
+    for player in players:
+        others = [p for p in players if p != player]
+        for r in range(n):
+            coeff = math.factorial(r) * math.factorial(n - r - 1) / math.factorial(n)
+            for subset in itertools.combinations(others, r):
+                marginal = metric(set(subset) | {player}) - metric(set(subset))
+                sv[player] += coeff * marginal
+    return sv
+
+
+def monte_carlo_shapley(
+    players: list, metric: Callable[[set], float], n_permutations: int, rng
+) -> dict:
+    """Permutation-sampling SV estimate for player counts where exact
+    enumeration blows up."""
+    contributions = {p: 0.0 for p in players}
+    for _ in range(n_permutations):
+        perm = list(players)
+        rng.shuffle(perm)
+        prefix: set = set()
+        prev = metric(prefix)
+        for player in perm:
+            prefix = prefix | {player}
+            current = metric(prefix)
+            contributions[player] += current - prev
+            prev = current
+    return {p: v / n_permutations for p, v in contributions.items()}
+
+
 class ShapleyValueEngine:
     def __init__(self, players: Iterable, last_round_metric: float = 0.0) -> None:
         self.players: list = sorted(players)

@@ -2,12 +2,9 @@
 enumeration for small player counts, Monte-Carlo permutations otherwise
 (reference surface: ``cyy_torch_algorithm.shapely_value.multiround_shapley_value``)."""
 
-import itertools
-import math
-
 import numpy as np
 
-from .base import ShapleyValueEngine
+from .base import ShapleyValueEngine, exact_shapley, monte_carlo_shapley
 
 
 class MultiRoundShapleyValue(ShapleyValueEngine):
@@ -36,33 +33,8 @@ class MultiRoundShapleyValue(ShapleyValueEngine):
         self._finish_round(round_number, sv)
 
     def _exact(self, players: list) -> dict:
-        n = len(players)
-        sv = {p: 0.0 for p in players}
-        for player in players:
-            others = [p for p in players if p != player]
-            for r in range(n):
-                coeff = (
-                    math.factorial(r) * math.factorial(n - r - 1) / math.factorial(n)
-                )
-                for subset in itertools.combinations(others, r):
-                    marginal = self._metric(set(subset) | {player}) - self._metric(
-                        set(subset)
-                    )
-                    sv[player] += coeff * marginal
-        return sv
+        return exact_shapley(players, self._metric)
 
     def _monte_carlo(self, players: list) -> dict:
-        n = len(players)
-        n_perms = self.mc_permutations or max(2 * n, 30)
-        contributions = {p: 0.0 for p in players}
-        for _ in range(n_perms):
-            perm = list(players)
-            self._rng.shuffle(perm)
-            v_prev = self._metric(())
-            coalition: list = []
-            for player in perm:
-                coalition.append(player)
-                v_cur = self._metric(coalition)
-                contributions[player] += v_cur - v_prev
-                v_prev = v_cur
-        return {p: contributions[p] / n_perms for p in players}
+        n_perms = self.mc_permutations or max(2 * len(players), 30)
+        return monte_carlo_shapley(players, self._metric, n_perms, self._rng)

@@ -61,6 +61,11 @@ class Worker(Executor):
     def _offload_from_device(self) -> None:
         pass
 
+    def _before_round(self) -> None:
+        """Per-round hook (runs before each round's local training; no
+        reference counterpart — subclasses use it for round-scoped state
+        such as neighbor resampling in ``fed_aas``)."""
+
     def _before_training(self) -> None:
         pass
 
@@ -99,6 +104,7 @@ class Worker(Executor):
                     if self._stopped():
                         break
                 self.trainer.set_visualizer_prefix(f"round: {self._round_num},")
+                self._before_round()
                 self.trainer.train(**kwargs)
                 self._round_num += 1
             get_logger().debug("finish %s", self.name)

@@ -1,0 +1,114 @@
+"""fed_aas and Hierarchical_shapley_value (the reference's config-only
+methods, SURVEY.md §2.9)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+from distributed_learning_simulator_tpu.shapley.hierarchical_shapley_value import (
+    HierarchicalShapleyValue,
+)
+from distributed_learning_simulator_tpu.training import train
+
+
+def test_hierarchical_engine_efficiency_axiom():
+    """Member values sum to v(N) - v(empty) (efficiency), and far fewer
+    metric evals than exact SV."""
+    players = list(range(6))
+    values = {p: 0.5 + 0.1 * p for p in players}
+    calls = []
+
+    def metric(subset):
+        calls.append(frozenset(subset))
+        return sum(values[p] for p in subset)
+
+    engine = HierarchicalShapleyValue(
+        players, last_round_metric=0.0, part_number=3, vp_size=3
+    )
+    engine.set_metric_function(metric)
+    engine.compute(round_number=1)
+    sv = engine.shapley_values[1]
+    total = metric(players)
+    assert math.isclose(sum(sv.values()), total, rel_tol=1e-9)
+    # additive game: each player's SV equals its own value
+    for p in players:
+        assert math.isclose(sv[p], values[p], rel_tol=1e-6), (p, sv)
+    # eval budget far below 2^6 enumeration of exact SV (which needs >300
+    # marginal evals); cache-unique subsets only
+    assert len(set(calls)) < 40
+
+
+def test_hierarchical_sv_e2e():
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        distributed_algorithm="Hierarchical_shapley_value",
+        worker_number=6,
+        batch_size=16,
+        round=1,
+        epoch=1,
+        learning_rate=0.05,
+        algorithm_kwargs={"part_number": 3, "vp_size": 3},
+        dataset_kwargs={"train_size": 96, "val_size": 16, "test_size": 32},
+    )
+    result = train(config)
+    assert result["performance"]
+    assert 1 in result["sv"], result.keys()
+    assert len(result["sv"][1]) == 6
+    total = sum(result["sv"][1].values())
+    assert np.isfinite(total)
+
+
+def test_fed_aas_e2e():
+    config = DistributedTrainingConfig(
+        dataset_name="Cora",
+        model_name="SimpleGCN",
+        distributed_algorithm="fed_aas",
+        worker_number=2,
+        batch_size=16,
+        round=2,
+        epoch=1,
+        learning_rate=0.01,
+        algorithm_kwargs={"share_feature": False, "batch_number": 1, "num_neighbor": 3},
+        dataset_kwargs={"num_nodes": 120, "num_edges": 480},
+    )
+    result = train(config)
+    assert len(result["performance"]) == 2
+    for stat in result["performance"].values():
+        assert np.isfinite(stat["test_loss"])
+
+
+def test_hierarchical_engine_mc_fallback_for_many_groups():
+    """Above exact_group_limit the engine samples permutations instead of
+    enumerating 2^G subsets — must stay cheap and approximately efficient."""
+    players = list(range(60))
+    values = {p: 0.1 + 0.01 * p for p in players}
+    calls = []
+
+    def metric(subset):
+        calls.append(1)
+        return sum(values[p] for p in subset)
+
+    engine = HierarchicalShapleyValue(
+        players, part_number=20, mc_permutations=20, seed=0
+    )
+    engine.set_metric_function(metric)
+    engine.compute(round_number=1)
+    sv = engine.shapley_values[1]
+    # additive game: MC over groups is exact in expectation, and intra-group
+    # exact split restores per-player values
+    assert math.isclose(
+        sum(sv.values()), sum(values.values()), rel_tol=1e-6
+    )
+    assert len(calls) < 5000
+
+
+def test_hierarchical_engine_rejects_bad_config():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        HierarchicalShapleyValue(list(range(6)))
+    with _pytest.raises(ValueError):
+        HierarchicalShapleyValue(list(range(6)), part_number=2, vp_size=2)
