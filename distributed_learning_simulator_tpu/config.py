@@ -65,6 +65,7 @@ class DistributedTrainingConfig:
     executor: str = "auto"  # auto | spmd | sequential
     save_dir: str = ""
     checkpoint_every_round: bool = True
+    profile: bool = False  # capture a jax profiler trace under save_dir/profile
 
     def load_config_and_process(self, overrides: dict[str, Any] | None = None) -> None:
         """Derive ``save_dir``/``log_file`` the way the reference does

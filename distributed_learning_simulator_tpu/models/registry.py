@@ -52,12 +52,31 @@ class ModelContext:
         variables = {"params": unflatten_nested(params)}
         return self.module.apply(variables, inputs, train=train, rngs=rngs)
 
+    def _cast_for_compute(self, tree):
+        if self.compute_dtype == jnp.float32:
+            return tree
+        return jax.tree.map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+            else x,
+            tree,
+        )
+
     def loss(self, params: Params, batch: dict, train: bool = False, rngs=None):
         """Masked mean softmax cross-entropy + accuracy counts.
 
-        ``batch`` = {"input", "target", "mask"}; mask weights padded samples 0.
+        ``batch`` = {"input", "target", "mask"}; mask weights padded
+        samples 0.  With ``compute_dtype=bfloat16`` (config ``use_amp``) the
+        forward/backward runs in bf16 — master params stay float32 and the
+        cast is differentiated through, so gradients come back float32 (the
+        mixed-precision recipe the MXU wants).
         """
-        logits = self.apply(params, batch["input"], train=train, rngs=rngs)
+        logits = self.apply(
+            self._cast_for_compute(params),
+            self._cast_for_compute(batch["input"]),
+            train=train,
+            rngs=rngs,
+        )
         return masked_ce_loss(logits, batch["target"], batch["mask"])
 
 

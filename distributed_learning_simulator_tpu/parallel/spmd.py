@@ -116,6 +116,7 @@ class SpmdFedAvgSession:
         practitioners,
         mesh: Mesh | None = None,
         quantization_level: int | None = None,
+        client_chunk: int = 0,
     ) -> None:
         self.config = config
         self.dc = dataset_collection
@@ -124,6 +125,9 @@ class SpmdFedAvgSession:
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_slots = client_slots(config.worker_number, self.mesh)
         self.quantization_level = quantization_level
+        self.client_chunk = client_chunk or int(
+            config.algorithm_kwargs.get("client_chunk", 0)
+        )
         self._stat: dict[int, dict] = {}
         self._max_acc = 0.0
 
@@ -184,16 +188,72 @@ class SpmdFedAvgSession:
             )
             return contribution, summed
 
+        def chunk_size(slots_local: int) -> int:
+            """Clients trained concurrently per device.  vmapping every
+            local slot at once materializes activations for all of them —
+            100 time-multiplexed clients of a conv net OOM a single chip —
+            so slots are scanned in chunks (the reference time-multiplexes
+            workers onto devices the same way, ``algorithm_factory.py:38-58``)."""
+            mb = self.client_chunk
+            if mb <= 0:
+                mb = 8 if jax.default_backend() == "tpu" else slots_local
+            mb = max(1, min(mb, slots_local))
+            while slots_local % mb:
+                mb -= 1
+            return mb
+
         def round_program(global_params, weights, rngs):
-            """shard_map body: vmap local clients, psum the reduction."""
+            """shard_map body: scan client chunks, vmap inside each, psum
+            the reduction."""
 
             def shard_body(global_params, data, weights, rngs):
-                contributions, metrics = jax.vmap(
-                    local_train, in_axes=(None, 0, 0, 0)
-                )(global_params, data, weights, rngs)
-                local_sum = jax.tree.map(
-                    lambda c: jnp.sum(c, axis=0), contributions
-                )
+                slots_local = weights.shape[0]
+                mb = chunk_size(slots_local)
+                if mb == slots_local:
+                    contributions, metrics = jax.vmap(
+                        local_train, in_axes=(None, 0, 0, 0)
+                    )(global_params, data, weights, rngs)
+                    local_sum = jax.tree.map(
+                        lambda c: jnp.sum(c, axis=0), contributions
+                    )
+                    metrics = jax.tree.map(lambda m: jnp.sum(m), metrics)
+                else:
+                    n_chunks = slots_local // mb
+
+                    def to_chunks(tree):
+                        return jax.tree.map(
+                            lambda x: x.reshape(n_chunks, mb, *x.shape[1:]), tree
+                        )
+
+                    def chunk_body(acc, chunk):
+                        data_k, w_k, r_k = chunk
+                        contrib, met = jax.vmap(
+                            local_train, in_axes=(None, 0, 0, 0)
+                        )(global_params, data_k, w_k, r_k)
+                        acc_sum, acc_met = acc
+                        acc_sum = jax.tree.map(
+                            lambda a, c: a + jnp.sum(c, axis=0), acc_sum, contrib
+                        )
+                        acc_met = jax.tree.map(
+                            lambda a, m: a + jnp.sum(m), acc_met, met
+                        )
+                        return (acc_sum, acc_met), None
+
+                    init = (
+                        jax.tree.map(
+                            lambda p: jnp.zeros(p.shape, jnp.float32), global_params
+                        ),
+                        {
+                            "loss_sum": jnp.float32(0),
+                            "correct": jnp.float32(0),
+                            "count": jnp.float32(0),
+                        },
+                    )
+                    (local_sum, metrics), _ = jax.lax.scan(
+                        chunk_body,
+                        init,
+                        (to_chunks(data), to_chunks(weights), to_chunks(rngs)),
+                    )
                 global_sum = jax.tree.map(
                     lambda s: jax.lax.psum(s, axis_name="clients"), local_sum
                 )

@@ -37,6 +37,10 @@ class AggregationServer(Server):
         self.__max_acc = 0.0  # plateau bookkeeping (owned by _convergent)
         self.need_init_performance = False
         self.__early_stop = self.config.algorithm_kwargs.get("early_stop", False)
+        import time as _time
+
+        self.__round_start = _time.monotonic()
+        self.__round_start_bytes = (0, 0)
 
     @property
     def early_stop(self) -> bool:
@@ -175,6 +179,18 @@ class AggregationServer(Server):
             parameter_dict, keep_performance_logger=keep_performance_logger
         )
         round_stat = {f"test_{k}": v for k, v in metric.items()}
+        # first-class per-round profiling counters (SURVEY.md §5 TPU plan):
+        # wall-clock + transport bytes since the previous round record
+        import time as _time
+
+        now = _time.monotonic()
+        round_stat["round_seconds"] = now - self.__round_start
+        round_stat["received_mb"] = (
+            self.received_bytes - self.__round_start_bytes[0]
+        ) / 1e6
+        round_stat["sent_mb"] = (self.sent_bytes - self.__round_start_bytes[1]) / 1e6
+        self.__round_start = now
+        self.__round_start_bytes = (self.received_bytes, self.sent_bytes)
         key = self._get_stat_key()
         assert key not in self.__stat
         self.__stat[key] = round_stat

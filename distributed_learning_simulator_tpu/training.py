@@ -87,6 +87,12 @@ def _build_task(
     model_ctx = create_model_context(
         config.model_name, dataset_collection, **dict(config.model_kwargs)
     )
+    if config.use_amp:
+        # reference use_amp (torch autocast) → bfloat16 compute on the MXU:
+        # params/optimizer state stay float32, forward+backward run bf16
+        import jax.numpy as jnp
+
+        model_ctx.compute_dtype = jnp.bfloat16
     hyper_parameter = HyperParameter.from_config(config)
     from .ml_type import MachineLearningPhase as Phase
 
@@ -184,6 +190,22 @@ def train(
     background; fetch results with :func:`get_training_result`."""
     task_id = uuid.uuid4() if return_task_id else None
     ctx = _build_task(config, practitioners=practitioners, task_id=task_id)
+    if ctx.config.profile and not return_task_id:
+        # SURVEY.md §5 TPU plan: first-class profiler integration — one
+        # xplane trace of the whole run, viewable with tensorboard/xprof
+        import contextlib
+
+        import jax
+
+        trace_dir = os.path.join(ctx.config.save_dir, "profile")
+        os.makedirs(trace_dir, exist_ok=True)
+        profiler_cm = jax.profiler.trace(trace_dir)
+    else:
+        profiler_cm = None
+    if profiler_cm is not None:
+        with profiler_cm:
+            return _run_task(ctx, return_task_id=False, task_id=task_id)
+    return _run_task(ctx, return_task_id=return_task_id, task_id=task_id)
     if ctx.config.executor == "spmd":
         algo = ctx.config.distributed_algorithm
         from .parallel.spmd import SpmdFedAvgSession, SpmdSignSGDSession

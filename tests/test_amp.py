@@ -1,0 +1,52 @@
+"""use_amp → bfloat16 compute path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+from distributed_learning_simulator_tpu.training import train
+
+
+def test_amp_grads_stay_float32():
+    from distributed_learning_simulator_tpu.data import create_dataset_collection
+    from distributed_learning_simulator_tpu.models import create_model_context
+    from distributed_learning_simulator_tpu.ml_type import MachineLearningPhase as Phase
+
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        dataset_kwargs={"train_size": 32, "val_size": 8, "test_size": 8},
+    )
+    dc = create_dataset_collection(config)
+    ctx = create_model_context("LeNet5", dc)
+    ctx.compute_dtype = jnp.bfloat16
+    params = ctx.init(jax.random.PRNGKey(0))
+    ds = dc.get_dataset(Phase.Training)
+    batch = {
+        "input": jnp.asarray(ds.inputs[:4], jnp.float32),
+        "target": jnp.asarray(ds.targets[:4]),
+        "mask": jnp.ones(4, jnp.float32),
+    }
+    (loss, _), grads = jax.value_and_grad(ctx.loss, has_aux=True)(params, batch)
+    assert loss.dtype == jnp.float32
+    for g, p in zip(jax.tree.leaves(grads), jax.tree.leaves(params)):
+        assert g.dtype == p.dtype == jnp.float32
+    assert np.isfinite(float(loss))
+
+
+def test_amp_e2e_fed_avg():
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        distributed_algorithm="fed_avg",
+        worker_number=2,
+        batch_size=16,
+        round=1,
+        epoch=1,
+        learning_rate=0.05,
+        use_amp=True,
+        dataset_kwargs={"train_size": 64, "val_size": 16, "test_size": 32},
+    )
+    result = train(config)
+    assert np.isfinite(result["performance"][1]["test_loss"])
