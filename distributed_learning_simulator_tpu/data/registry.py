@@ -275,6 +275,17 @@ _graph_factory("PubMed", 2048, 128, 3)
 _graph_factory("Coauthor_CS", 4096, 128, 15)
 _graph_factory("dblp", 2048, 128, 4)
 _graph_factory("reddit", 4096, 128, 41)
+_graph_factory("Reddit", 4096, 128, 41)
 _graph_factory("yelp", 4096, 128, 10)
 _graph_factory("AmazonProduct", 4096, 128, 12)
 _graph_factory("amazonproduct", 4096, 128, 12)
+
+
+@register_dataset("CitationFull")
+def _citation_full(name: str = "DBLP", **kwargs: object) -> DatasetCollection:
+    """Reference ``conf/fed_aas/dblp.yaml`` selects a CitationFull sub-dataset
+    via ``dataset_kwargs: {name: DBLP}`` (torch_geometric CitationFull)."""
+    class_counts = {"DBLP": 4, "Cora": 70, "Cora_ML": 7, "CiteSeer": 6, "PubMed": 3}
+    return _synthetic_graph(
+        f"CitationFull_{name}", 2048, 128, class_counts.get(str(name), 4)
+    )

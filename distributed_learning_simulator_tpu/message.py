@@ -86,10 +86,13 @@ class ParameterFileMessage(ParameterMessageBase):
 
 def get_message_size(message: Message) -> int:
     """Payload bytes of a message (reference ``get_message_size``,
-    ``message.py:52-62``)."""
+    ``message.py:52-62``).  Encoded (quantized) payloads report their
+    compressed wire size via their ``nbytes`` property."""
     total = 0
     for field in dataclasses.fields(message):
         value = getattr(message, field.name)
         if isinstance(value, dict):
             total += param_nbytes(value)
+        elif hasattr(value, "nbytes"):
+            total += int(value.nbytes)
     return total

@@ -89,6 +89,12 @@ class SimpleGCN(nn.Module):
         return self.head(self.embed(inputs, train=train), inputs, train=train)
 
 
+class OneGCN(SimpleGCN):
+    """Single-message-passing-layer GCN (reference ``conf/fed_aas/dblp.yaml``
+    names the torch_geometric ``OneGCN``); structurally one GCN conv + linear
+    head, which ``SimpleGCN`` already is."""
+
+
 def _graph_context(name: str, module, dataset_collection) -> ModelContext:
     from ..ml_type import MachineLearningPhase as Phase
 
@@ -114,4 +120,11 @@ def _two_gcn(dataset_collection, hidden: int = 64, **kwargs) -> ModelContext:
 def _simple_gcn(dataset_collection, hidden: int = 64, **kwargs) -> ModelContext:
     return _graph_context(
         "SimpleGCN", SimpleGCN(dataset_collection.num_classes, hidden), dataset_collection
+    )
+
+
+@register_model("OneGCN", "onegcn")
+def _one_gcn(dataset_collection, hidden: int = 64, **kwargs) -> ModelContext:
+    return _graph_context(
+        "OneGCN", OneGCN(dataset_collection.num_classes, hidden), dataset_collection
     )

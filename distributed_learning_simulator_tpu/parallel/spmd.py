@@ -239,21 +239,24 @@ class SpmdFedAvgSession:
                         )
                         return (acc_sum, acc_met), None
 
+                    chunks = (to_chunks(data), to_chunks(weights), to_chunks(rngs))
+                    # metric accumulator structure comes from the train fn
+                    # itself (trace-time eval_shape), not hardcoded keys
+                    _, met_shapes = jax.eval_shape(
+                        lambda d, w, r: jax.vmap(
+                            local_train, in_axes=(None, 0, 0, 0)
+                        )(global_params, d, w, r),
+                        *jax.tree.map(lambda x: x[0], chunks),
+                    )
                     init = (
                         jax.tree.map(
                             lambda p: jnp.zeros(p.shape, jnp.float32), global_params
                         ),
-                        {
-                            "loss_sum": jnp.float32(0),
-                            "correct": jnp.float32(0),
-                            "count": jnp.float32(0),
-                        },
+                        jax.tree.map(
+                            lambda s: jnp.zeros((), s.dtype), met_shapes
+                        ),
                     )
-                    (local_sum, metrics), _ = jax.lax.scan(
-                        chunk_body,
-                        init,
-                        (to_chunks(data), to_chunks(weights), to_chunks(rngs)),
-                    )
+                    (local_sum, metrics), _ = jax.lax.scan(chunk_body, init, chunks)
                 global_sum = jax.tree.map(
                     lambda s: jax.lax.psum(s, axis_name="clients"), local_sum
                 )

@@ -28,10 +28,18 @@ class Server(Executor):
             name = f"server of {task_id}"
         super().__init__(config=config, name=name, task_context=task_context)
         self._endpoint = endpoint
-        # first-class communication counters (SURVEY.md §5: byte accounting
-        # via get_message_size becomes a built-in metric, not a log scrape)
-        self.received_bytes = 0
-        self.sent_bytes = 0
+
+    # first-class communication counters (SURVEY.md §5: byte accounting via
+    # get_message_size becomes a built-in metric, not a log scrape).  The
+    # endpoint counts at the wire boundary, so quantized transports report
+    # compressed sizes.
+    @property
+    def received_bytes(self) -> int:
+        return getattr(self._endpoint, "received_bytes", 0)
+
+    @property
+    def sent_bytes(self) -> int:
+        return getattr(self._endpoint, "sent_bytes", 0)
 
     @property
     def worker_number(self) -> int:
@@ -88,10 +96,6 @@ class Server(Executor):
                 for worker_id in sorted(worker_set):
                     if self._endpoint.has_data(worker_id):
                         data = self._endpoint.get(worker_id)
-                        if data is not None:
-                            from ..message import get_message_size
-
-                            self.received_bytes += get_message_size(data)
                         self._process_worker_data(worker_id, data)
                         worker_set.remove(worker_id)
                         progressed = True
@@ -119,20 +123,15 @@ class Server(Executor):
         pass
 
     def _send_result(self, result: Message) -> None:
-        from ..message import get_message_size
-
         self._before_send_result(result=result)
         if "worker_result" in result.other_data:
             for worker_id, data in result.other_data["worker_result"].items():
                 self._endpoint.send(worker_id=worker_id, data=data)
-                if data is not None:
-                    self.sent_bytes += get_message_size(data)
         else:
             selected_workers = self._select_workers()
             get_logger().debug("choose workers %s", selected_workers)
             if selected_workers:
                 self._endpoint.broadcast(data=result, worker_ids=selected_workers)
-                self.sent_bytes += get_message_size(result) * len(selected_workers)
             unselected = set(range(self.worker_number)) - selected_workers
             if unselected:
                 self._endpoint.broadcast(data=None, worker_ids=unselected)

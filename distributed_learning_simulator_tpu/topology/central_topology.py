@@ -78,10 +78,18 @@ class ClientEndpoint:
 
 class ServerEndpoint:
     """Server-side endpoint (reference surface: per-worker get/send/has_data,
-    broadcast, close)."""
+    broadcast, close).
+
+    Counts wire bytes at this boundary: quantized subclasses encode *before*
+    calling ``super().send`` and decode *after* ``super().get``, so the
+    counters see compressed payload sizes (reference logs these through
+    ``check_compression_ratio``; here they are first-class counters read by
+    the server's per-round metrics)."""
 
     def __init__(self, topology: CentralTopology) -> None:
         self._topology = topology
+        self.received_bytes = 0
+        self.sent_bytes = 0
 
     @property
     def worker_num(self) -> int:
@@ -91,9 +99,20 @@ class ServerEndpoint:
         return self._topology._to_server[worker_id].has_data()
 
     def get(self, worker_id: int, timeout: float | None = None) -> Any:
-        return self._topology._to_server[worker_id].get(timeout=timeout)
+        data = self._topology._to_server[worker_id].get(timeout=timeout)
+        if data is not None:
+            from ..message import Message, get_message_size
+
+            if isinstance(data, Message):
+                self.received_bytes += get_message_size(data)
+        return data
 
     def send(self, worker_id: int, data: Any) -> None:
+        if data is not None:
+            from ..message import Message, get_message_size
+
+            if isinstance(data, Message):
+                self.sent_bytes += get_message_size(data)
         self._topology._to_worker[worker_id].put(data)
 
     def broadcast(self, data: Any, worker_ids: set[int] | None = None) -> None:

@@ -71,6 +71,15 @@ class _EncodedPayload:
     def __init__(self, blob: dict) -> None:
         self.blob = blob
 
+    @property
+    def nbytes(self) -> int:
+        """Compressed wire size (what byte accounting should count)."""
+        from ..ops.pytree import param_nbytes
+
+        return param_nbytes(
+            {k: v for k, v in self.blob.items() if k != "treedef"}
+        )
+
 
 class QuantClientEndpoint(_QuantCodecMixin, ClientEndpoint):
     """Reference ``QuantClientEndpoint`` (``quantized_endpoint.py:14-44``).

@@ -13,6 +13,7 @@ payloads through in-memory endpoints.  Concurrent tasks keep the reference's
 import copy
 import dataclasses
 import math
+import os
 import threading
 import uuid
 from typing import Any
@@ -190,22 +191,22 @@ def train(
     background; fetch results with :func:`get_training_result`."""
     task_id = uuid.uuid4() if return_task_id else None
     ctx = _build_task(config, practitioners=practitioners, task_id=task_id)
+    import contextlib
+
+    profiler_cm: Any = contextlib.nullcontext()
     if ctx.config.profile and not return_task_id:
         # SURVEY.md §5 TPU plan: first-class profiler integration — one
         # xplane trace of the whole run, viewable with tensorboard/xprof
-        import contextlib
-
         import jax
 
         trace_dir = os.path.join(ctx.config.save_dir, "profile")
         os.makedirs(trace_dir, exist_ok=True)
         profiler_cm = jax.profiler.trace(trace_dir)
-    else:
-        profiler_cm = None
-    if profiler_cm is not None:
-        with profiler_cm:
-            return _run_task(ctx, return_task_id=False, task_id=task_id)
-    return _run_task(ctx, return_task_id=return_task_id, task_id=task_id)
+    with profiler_cm:
+        return _run_task(ctx, return_task_id=return_task_id, task_id=task_id)
+
+
+def _run_task(ctx: TaskContext, return_task_id: bool, task_id: Any) -> dict | Any:
     if ctx.config.executor == "spmd":
         algo = ctx.config.distributed_algorithm
         from .parallel.spmd import SpmdFedAvgSession, SpmdSignSGDSession

@@ -44,6 +44,46 @@ def test_collect_round_metrics(tmp_path):
     assert table["test_accuracy"][2] == [0.7]
 
 
+def test_graph_exp_analyzer(tmp_path, monkeypatch):
+    session_dir = _fake_session(tmp_path)
+    (session_dir / "server" / "config.json").write_text(
+        json.dumps(
+            {
+                "distributed_algorithm": "fed_gnn",
+                "dataset_name": "Coauthor_CS",
+                "model_name": "TwoGCN",
+                "round": 2,
+                "worker_number": 2,
+                "algorithm_kwargs": {"share_feature": True},
+            }
+        )
+    )
+    for worker, edges in (("worker_0", 10), ("worker_1", 20)):
+        worker_dir = session_dir / worker
+        worker_dir.mkdir(exist_ok=True)
+        (worker_dir / "graph_worker_stat.json").write_text(
+            json.dumps(
+                {
+                    "embedding_bytes": 100,
+                    "in_client_edge_cnt": edges,
+                    "round_bytes": {"1": 5, "2": 7},
+                }
+            )
+        )
+    from distributed_learning_simulator_tpu.analysis.graph_exp_analyzer import (
+        analyze_graph_session,
+        write_exp_tables,
+    )
+
+    row = analyze_graph_session(str(session_dir))
+    assert row["last_test_acc"] == 0.7
+    assert row["in_client_edge_cnt"]["mean"] == 15.0
+    assert row["round_bytes"] == {"1": 10, "2": 14}
+    monkeypatch.chdir(tmp_path)
+    write_exp_tables([row])
+    assert os.path.isfile("exp.txt") and os.path.isfile("exp.json")
+
+
 def test_cost_model_and_scraper(tmp_path):
     model = CommunicationCostModel(parameter_count=1000, worker_number=4, rounds=10)
     full = model.fed_avg_bytes()

@@ -34,7 +34,14 @@ def run(config) -> dict:
 
 
 def test_fed_paq(tmp_session_dir):
-    run(tiny_config("fed_paq"))
+    result = run(tiny_config("fed_paq"))
+    baseline = run(tiny_config("fed_avg"))
+    # byte accounting counts at the wire: quantized uploads must report
+    # compressed sizes, not the dequantized full-precision dicts
+    assert (
+        result["performance"][1]["received_mb"]
+        < 0.5 * baseline["performance"][1]["received_mb"]
+    )
 
 
 def test_fed_dropout_avg(tmp_session_dir):
