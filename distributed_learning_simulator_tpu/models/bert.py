@@ -1,0 +1,133 @@
+"""BERT-class text encoders (flax.linen).
+
+BASELINE.json's large-scale headline config is "BERT-base AGNews, 1000
+clients"; the reference reaches BERT-family models through
+``cyy_torch_text``'s import-time registry (``common_import.py:1-2``).  With
+zero egress there are no pretrained weights — the architecture (learned
+token+position embeddings with LayerNorm, post-LN encoder stack, tanh
+pooler) is trained from scratch at the same shapes.
+
+TPU notes: d_model/mlp are 128-multiples for the base size so every matmul
+tiles the MXU; padding is handled by an attention mask (static shapes); the
+pooler reads a masked mean rather than position 0 because our synthetic
+tokenizer emits no [CLS] (the reference's spacy pipeline doesn't either —
+its transformer pools the same way).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .registry import ModelContext, example_batch, register_model
+
+
+class BertLayer(nn.Module):
+    """Post-LN transformer encoder layer (BERT style)."""
+
+    num_heads: int
+    mlp_dim: int
+    dropout_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, x, pad_mask, train: bool = False):
+        attn_mask = pad_mask[:, None, None, :]  # mask on keys
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads,
+            deterministic=not train,
+            dropout_rate=self.dropout_rate,
+        )(x, x, mask=attn_mask)
+        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        x = nn.LayerNorm()(x + y)
+        y = nn.Dense(self.mlp_dim)(x)
+        y = nn.gelu(y)
+        y = nn.Dense(x.shape[-1])(y)
+        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        return nn.LayerNorm()(x + y)
+
+
+class BertClassifier(nn.Module):
+    vocab_size: int
+    num_classes: int
+    d_model: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    pad_id: int = 0
+    dropout_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        pad_mask = tokens != self.pad_id  # [B, L]
+        x = nn.Embed(self.vocab_size, self.d_model, name="token_embed")(tokens)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, self.max_len, self.d_model),
+        )
+        x = x + pos[:, : tokens.shape[1]]
+        x = nn.LayerNorm(name="embed_norm")(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        for i in range(self.num_layers):
+            x = BertLayer(
+                self.num_heads, self.mlp_dim, self.dropout_rate, name=f"Layer_{i}"
+            )(x, pad_mask, train=train)
+        denom = jnp.maximum(pad_mask.sum(axis=1, keepdims=True), 1)
+        pooled = (x * pad_mask[..., None]).sum(axis=1) / denom
+        pooled = nn.tanh(nn.Dense(self.d_model, name="pooler")(pooled))
+        pooled = nn.Dropout(self.dropout_rate, deterministic=not train)(pooled)
+        return nn.Dense(self.num_classes, name="classifier")(pooled)
+
+
+def _make_bert(dataset_collection, *, d_model, num_layers, num_heads, mlp_dim,
+               name, max_len=0, dropout_rate=0.1):
+    meta = dataset_collection.metadata
+    example = example_batch(dataset_collection)
+    module = BertClassifier(
+        vocab_size=meta.get("vocab_size", 30522),
+        num_classes=dataset_collection.num_classes,
+        d_model=d_model,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        mlp_dim=mlp_dim,
+        max_len=max_len or meta.get("max_len", example.shape[1]),
+        pad_id=meta.get("pad_id", 0),
+        dropout_rate=dropout_rate,
+    )
+    return ModelContext(
+        name=name,
+        module=module,
+        example_input=example,
+        num_classes=dataset_collection.num_classes,
+        dataset_type="text",
+    )
+
+
+@register_model("bert_base", "bert-base", "BertForSequenceClassification")
+def _bert_base(dataset_collection, max_len: int = 0, dropout_rate: float = 0.1,
+               **kwargs) -> ModelContext:
+    return _make_bert(
+        dataset_collection,
+        d_model=768, num_layers=12, num_heads=12, mlp_dim=3072,
+        name="bert_base", max_len=max_len, dropout_rate=dropout_rate,
+    )
+
+
+@register_model("bert_small", "bert-small")
+def _bert_small(dataset_collection, max_len: int = 0, dropout_rate: float = 0.1,
+                **kwargs) -> ModelContext:
+    return _make_bert(
+        dataset_collection,
+        d_model=256, num_layers=4, num_heads=4, mlp_dim=1024,
+        name="bert_small", max_len=max_len, dropout_rate=dropout_rate,
+    )
+
+
+@register_model("bert_tiny", "bert-tiny")
+def _bert_tiny(dataset_collection, max_len: int = 0, dropout_rate: float = 0.1,
+               **kwargs) -> ModelContext:
+    # test-scale variant
+    return _make_bert(
+        dataset_collection,
+        d_model=32, num_layers=2, num_heads=2, mlp_dim=64,
+        name="bert_tiny", max_len=max_len, dropout_rate=dropout_rate,
+    )

@@ -1,0 +1,157 @@
+"""Vision Transformer family (flax.linen).
+
+BASELINE.json's FedOBD headline config is "ViT-Base CIFAR-100, block-dropout
+compression" — the reference zoo reaches ViT through ``cyy_torch_vision``'s
+import-time registry (``common_import.py:1-2``); here the family is
+first-party.  Design is TPU-first: all matmul dims are MXU-friendly
+multiples of 128 for the base size, patch embedding is a strided Conv
+(lowered to one big matmul), pre-LN blocks so residuals stay in
+``compute_dtype`` (bf16 under ``use_amp``) without LayerNorm re-centering
+the main path, and mean pooling instead of a CLS token so the sequence
+length stays a static power of two.
+
+For FedOBD block decomposition each ``Block_i`` submodule is one dropout
+unit, matching the reference's transformer-encoder-layer block type
+(``method/fed_obd/obd_algorithm.py:33-86``).
+"""
+
+import flax.linen as nn
+
+from .registry import ModelContext, example_batch, register_model
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d_model = x.shape[-1]
+        y = nn.Dense(self.mlp_dim)(x)
+        y = nn.gelu(y)
+        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        y = nn.Dense(d_model)(y)
+        return nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+
+
+class ViTBlock(nn.Module):
+    """Pre-LN transformer encoder block (ViT style)."""
+
+    num_heads: int
+    mlp_dim: int
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = nn.LayerNorm()(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads,
+            deterministic=not train,
+            dropout_rate=self.dropout_rate,
+        )(y, y)
+        x = x + nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        y = nn.LayerNorm()(x)
+        return x + MlpBlock(self.mlp_dim, self.dropout_rate)(y, train=train)
+
+
+class VisionTransformer(nn.Module):
+    num_classes: int
+    patch_size: int = 4
+    d_model: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        p = self.patch_size
+        x = nn.Conv(
+            self.d_model, (p, p), strides=(p, p), padding="VALID", name="patch_embed"
+        )(x)
+        batch = x.shape[0]
+        x = x.reshape(batch, -1, self.d_model)  # [B, N_patches, D]
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, x.shape[1], self.d_model),
+        )
+        x = x + pos
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        for i in range(self.num_layers):
+            x = ViTBlock(
+                self.num_heads,
+                self.mlp_dim,
+                self.dropout_rate,
+                name=f"Block_{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(name="encoder_norm")(x)
+        x = x.mean(axis=1)  # global average pool over patches
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+def _auto_patch(image_size: int) -> int:
+    """ViT-Base uses 16px patches at 224; small inputs (CIFAR) use 4."""
+    return 16 if image_size >= 128 else 4
+
+
+def _make_vit(dataset_collection, *, d_model, num_layers, num_heads, mlp_dim, name,
+              patch_size=0, dropout_rate=0.0):
+    example = example_batch(dataset_collection)
+    image_size = example.shape[1]
+    module = VisionTransformer(
+        num_classes=dataset_collection.num_classes,
+        patch_size=patch_size or _auto_patch(image_size),
+        d_model=d_model,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        mlp_dim=mlp_dim,
+        dropout_rate=dropout_rate,
+    )
+    return ModelContext(
+        name=name,
+        module=module,
+        example_input=example,
+        num_classes=dataset_collection.num_classes,
+    )
+
+
+@register_model("vit_base", "ViT-Base", "vit-b")
+def _vit_base(dataset_collection, patch_size: int = 0, dropout_rate: float = 0.0,
+              **kwargs) -> ModelContext:
+    return _make_vit(
+        dataset_collection,
+        d_model=768, num_layers=12, num_heads=12, mlp_dim=3072,
+        name="vit_base", patch_size=patch_size, dropout_rate=dropout_rate,
+    )
+
+
+@register_model("vit_b_16", "vit_base_patch16")
+def _vit_b_16(dataset_collection, dropout_rate: float = 0.0, **kwargs) -> ModelContext:
+    # the /16 name pins the patch size regardless of input resolution
+    return _make_vit(
+        dataset_collection,
+        d_model=768, num_layers=12, num_heads=12, mlp_dim=3072,
+        name="vit_b_16", patch_size=16, dropout_rate=dropout_rate,
+    )
+
+
+@register_model("vit_small", "ViT-Small")
+def _vit_small(dataset_collection, patch_size: int = 0, dropout_rate: float = 0.0,
+               **kwargs) -> ModelContext:
+    return _make_vit(
+        dataset_collection,
+        d_model=384, num_layers=12, num_heads=6, mlp_dim=1536,
+        name="vit_small", patch_size=patch_size, dropout_rate=dropout_rate,
+    )
+
+
+@register_model("vit_tiny", "ViT-Tiny")
+def _vit_tiny(dataset_collection, patch_size: int = 0, dropout_rate: float = 0.0,
+              **kwargs) -> ModelContext:
+    # test-scale variant: same topology, toy widths
+    return _make_vit(
+        dataset_collection,
+        d_model=32, num_layers=2, num_heads=2, mlp_dim=64,
+        name="vit_tiny", patch_size=patch_size or 8, dropout_rate=dropout_rate,
+    )
