@@ -15,33 +15,9 @@ its transformer pools the same way).
 """
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 from .registry import ModelContext, example_batch, register_model
-
-
-class BertLayer(nn.Module):
-    """Post-LN transformer encoder layer (BERT style)."""
-
-    num_heads: int
-    mlp_dim: int
-    dropout_rate: float = 0.1
-
-    @nn.compact
-    def __call__(self, x, pad_mask, train: bool = False):
-        attn_mask = pad_mask[:, None, None, :]  # mask on keys
-        y = nn.MultiHeadDotProductAttention(
-            num_heads=self.num_heads,
-            deterministic=not train,
-            dropout_rate=self.dropout_rate,
-        )(x, x, mask=attn_mask)
-        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
-        x = nn.LayerNorm()(x + y)
-        y = nn.Dense(self.mlp_dim)(x)
-        y = nn.gelu(y)
-        y = nn.Dense(x.shape[-1])(y)
-        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
-        return nn.LayerNorm()(x + y)
+from .text import EncoderLayer, masked_mean_pool
 
 
 class BertClassifier(nn.Module):
@@ -68,11 +44,17 @@ class BertClassifier(nn.Module):
         x = nn.LayerNorm(name="embed_norm")(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         for i in range(self.num_layers):
-            x = BertLayer(
-                self.num_heads, self.mlp_dim, self.dropout_rate, name=f"Layer_{i}"
+            x = EncoderLayer(
+                self.d_model,
+                self.num_heads,
+                self.mlp_dim,
+                self.dropout_rate,
+                activation="gelu",
+                attn_out_dropout=True,
+                ffn_dropout_on_output=True,
+                name=f"Layer_{i}",
             )(x, pad_mask, train=train)
-        denom = jnp.maximum(pad_mask.sum(axis=1, keepdims=True), 1)
-        pooled = (x * pad_mask[..., None]).sum(axis=1) / denom
+        pooled = masked_mean_pool(x, pad_mask)
         pooled = nn.tanh(nn.Dense(self.d_model, name="pooler")(pooled))
         pooled = nn.Dropout(self.dropout_rate, deterministic=not train)(pooled)
         return nn.Dense(self.num_classes, name="classifier")(pooled)

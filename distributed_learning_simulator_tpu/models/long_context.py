@@ -12,10 +12,9 @@ back to dense attention — same parameters, same math.
 from typing import Any
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 from .registry import ModelContext, example_batch, register_model
-from .text import sinusoidal_positions
+from .text import masked_mean_pool, sinusoidal_positions
 
 
 class LongContextSelfAttention(nn.Module):
@@ -85,8 +84,7 @@ class LongContextTransformer(nn.Module):
                 self.d_model, self.nhead, self.sp_mesh, self.sp_impl
             )(x, pad_mask, train=train)
         x = nn.LayerNorm()(x)
-        denom = jnp.maximum(pad_mask.sum(axis=1, keepdims=True), 1)
-        pooled = (x * pad_mask[..., None]).sum(axis=1) / denom
+        pooled = masked_mean_pool(x, pad_mask)
         return nn.Dense(self.num_classes)(pooled)
 
 

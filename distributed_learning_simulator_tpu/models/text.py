@@ -23,11 +23,28 @@ def sinusoidal_positions(max_len: int, d_model: int) -> np.ndarray:
     return enc
 
 
+def masked_mean_pool(x, pad_mask):
+    """Mean over non-pad positions; safe when a row is all padding."""
+    denom = jnp.maximum(pad_mask.sum(axis=1, keepdims=True), 1)
+    return (x * pad_mask[..., None]).sum(axis=1) / denom
+
+
 class EncoderLayer(nn.Module):
+    """Post-LN transformer encoder layer.
+
+    Shared between the reference-parity IMDB classifier (relu, dropout after
+    the FFN activation) and the BERT family (gelu, dropout on the attention
+    output and after the second FFN dense) — the two placements are toggled
+    rather than duplicated.
+    """
+
     d_model: int
     nhead: int
     dim_feedforward: int
     dropout_rate: float = 0.1
+    activation: str = "relu"  # "relu" | "gelu"
+    attn_out_dropout: bool = False
+    ffn_dropout_on_output: bool = False
 
     @nn.compact
     def __call__(self, x, pad_mask, train: bool = False):
@@ -38,11 +55,16 @@ class EncoderLayer(nn.Module):
             deterministic=not train,
             dropout_rate=self.dropout_rate,
         )(x, x, mask=attn_mask)
+        if self.attn_out_dropout:
+            y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = nn.LayerNorm()(x + y)
         y = nn.Dense(self.dim_feedforward)(x)
-        y = nn.relu(y)
-        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        y = nn.gelu(y) if self.activation == "gelu" else nn.relu(y)
+        if not self.ffn_dropout_on_output:
+            y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         y = nn.Dense(self.d_model)(y)
+        if self.ffn_dropout_on_output:
+            y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         return nn.LayerNorm()(x + y)
 
 
@@ -64,8 +86,7 @@ class TransformerClassifier(nn.Module):
             x = EncoderLayer(self.d_model, self.nhead, 4 * self.d_model)(
                 x, pad_mask, train=train
             )
-        denom = jnp.maximum(pad_mask.sum(axis=1, keepdims=True), 1)
-        pooled = (x * pad_mask[..., None]).sum(axis=1) / denom
+        pooled = masked_mean_pool(x, pad_mask)
         return nn.Dense(self.num_classes)(pooled)
 
 

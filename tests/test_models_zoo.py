@@ -51,6 +51,19 @@ def test_forward_and_grad(dataset, model, dkw):
     assert np.isfinite(total) and total > 0.0
 
 
+def test_bert_dropout_training_path():
+    """train=True exercises the dropout-rng plumbing (bert defaults 0.1)."""
+    dc, ctx, params = _build("AGNews", "bert_tiny", dataset_kwargs={"max_len": 32})
+    train = dc.get_dataset(Phase.Training)
+    batch = {
+        "input": jnp.asarray(train.inputs[:4]),
+        "target": jnp.asarray(train.targets[:4]),
+        "mask": jnp.ones(4, jnp.float32),
+    }
+    loss, _ = ctx.loss(params, batch, True, rngs={"dropout": jax.random.PRNGKey(1)})
+    assert np.isfinite(float(loss))
+
+
 def _param_count(shapes) -> int:
     return sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
 
