@@ -73,6 +73,42 @@ def test_fed_obd(tmp_session_dir):
     run(config)
 
 
+def test_fed_obd_sq(tmp_session_dir):
+    """fed_obd with StochasticQuant endpoints instead of NNADQ (reference
+    ``method/fed_obd/__init__.py:16-22``)."""
+    config = tiny_config(
+        "fed_obd_sq",
+        round=2,
+        algorithm_kwargs={"second_phase_epoch": 1, "dropout_rate": 0.5},
+    )
+    run(config)
+
+
+def test_fed_gcn(tmp_session_dir):
+    """FedGCN variant: feature sharing forced on even when the config says
+    otherwise (reference ``method/fed_gcn/worker.py:4-7``)."""
+    config = DistributedTrainingConfig(
+        dataset_name="Cora",
+        model_name="TwoGCN",
+        distributed_algorithm="fed_gcn",
+        worker_number=2,
+        round=1,
+        epoch=1,
+        learning_rate=0.01,
+        dataset_kwargs={},
+        algorithm_kwargs={"share_feature": False},
+    )
+    run(config)
+
+
+def test_multiround_shapley(tmp_session_dir):
+    config = tiny_config("multiround_shapley_value", worker_number=3)
+    result = run(config)
+    assert "sv" in result
+    assert set(result["sv"]) == {1}
+    assert len(result["sv"][1]) == 3
+
+
 def test_gtg_shapley(tmp_session_dir):
     config = tiny_config("GTG_shapley_value", worker_number=3)
     result = run(config)
