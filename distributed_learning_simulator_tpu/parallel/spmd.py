@@ -278,7 +278,9 @@ class SpmdFedAvgSession:
                 out_specs=(P(), P()),
             )(global_params, self._data, weights, rngs)
 
-        return jax.jit(round_program)
+        # donate the old global params: the round returns the new ones, so
+        # XLA can reuse the buffer instead of holding both copies live
+        return jax.jit(round_program, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
     def _select_weights(self, round_number: int) -> np.ndarray:

@@ -63,6 +63,9 @@ def _build_task(
     practitioners=None,
     task_id=None,
 ) -> TaskContext:
+    from .utils.compilation_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     config = copy.deepcopy(config)
     if not config.save_dir:
         config.load_config_and_process()

@@ -1,0 +1,32 @@
+"""Persistent XLA compilation cache.
+
+First compile of the big round programs is slow (tens of seconds on TPU,
+minutes on the CPU test mesh); the reference pays the analogous torch
+warmup on every process start.  Caching compiled executables on disk makes
+every process after the first start hot — notably ``bench.py`` and the
+driver's repeated runs.  Opt out with ``DLS_TPU_NO_COMPILE_CACHE=1``.
+"""
+
+import os
+
+_enabled = False
+
+
+def enable_persistent_cache() -> None:
+    global _enabled
+    if _enabled or os.environ.get("DLS_TPU_NO_COMPILE_CACHE"):
+        return
+    import jax
+
+    cache_dir = os.environ.get(
+        "DLS_TPU_COMPILE_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "dls_tpu_xla"),
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache even fast compiles: the suite compiles many small programs
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # cache is an optimization, never a hard dependency
+        pass
+    _enabled = True

@@ -23,9 +23,9 @@ def test_concurrent_tasks(tmp_session_dir):
     practitioners = create_practitioners(config)
     task_ids = [
         train(config, practitioners=practitioners, return_task_id=True)
-        for _ in range(3)
+        for _ in range(5)
     ]
-    assert len(set(task_ids)) == 3
+    assert len(set(task_ids)) == 5
     for task_id in task_ids:
         result = get_training_result(task_id)
         assert result["performance"]
