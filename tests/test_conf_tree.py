@@ -1,0 +1,37 @@
+"""Every conf YAML must load into a runnable configuration: registered
+algorithm, resolvable dataset + model (the reference asserts registration at
+``simulation_lib/algorithm_factory.py:25``; this extends the guard to the
+whole conf tree so a config family can't silently rot)."""
+
+import glob
+import os
+
+import pytest
+
+from distributed_learning_simulator_tpu.config import CONF_DIR, load_config_from_file
+from distributed_learning_simulator_tpu.data.registry import global_dataset_factory
+from distributed_learning_simulator_tpu.method import CentralizedAlgorithmFactory
+from distributed_learning_simulator_tpu.models.registry import global_model_factory
+
+ALL_CONFS = sorted(
+    os.path.relpath(p, CONF_DIR)
+    for p in glob.glob(os.path.join(CONF_DIR, "**", "*.yaml"), recursive=True)
+    if os.path.basename(p) != "global.yaml"
+)
+
+
+@pytest.mark.parametrize("conf", ALL_CONFS)
+def test_conf_loads_and_resolves(conf, tmp_session_dir):
+    config = load_config_from_file(os.path.join(CONF_DIR, conf))
+    assert config.dataset_name, conf
+    assert config.model_name, conf
+    assert CentralizedAlgorithmFactory.has_algorithm(
+        config.distributed_algorithm
+    ), f"{conf}: unregistered algorithm {config.distributed_algorithm}"
+    assert config.dataset_name.lower() in {
+        n.lower() for n in global_dataset_factory
+    }, f"{conf}: unknown dataset {config.dataset_name}"
+    assert (
+        config.model_name.lower() in global_model_factory
+    ), f"{conf}: unknown model {config.model_name}"
+    assert config.worker_number >= 1 and config.round >= 1, conf
