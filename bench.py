@@ -66,13 +66,19 @@ def measure_spmd() -> float:
         jax.random.split(jax.random.PRNGKey(0), session.n_slots),
         session._client_sharding,
     )
+    import numpy as np
+
     # warmup/compile
-    global_params, _ = session._round_fn(global_params, weights, rngs)
-    jax.block_until_ready(jax.tree.leaves(global_params))
+    global_params, metrics = session._round_fn(global_params, weights, rngs)
+    # sync via host fetch, not just block_until_ready: on the tunneled axon
+    # platform a runtime failure can pass block_until_ready silently and
+    # only surface (or block) at transfer time — fetching a scalar derived
+    # from the whole round both hard-syncs and validates the execution
+    float(np.asarray(jax.tree.leaves(metrics)[0]))
     start = time.monotonic()
     for _ in range(ROUNDS_MEASURED):
         global_params, metrics = session._round_fn(global_params, weights, rngs)
-    jax.block_until_ready(jax.tree.leaves(global_params))
+    float(np.asarray(jax.tree.leaves(metrics)[0]))
     elapsed = time.monotonic() - start
     return ROUNDS_MEASURED / elapsed
 

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Canonical large-scale FedOBD workloads (100 clients, NNADQ transport).
+set -e
+for dataset in cifar10 cifar100 imdb; do
+  python3 ./simulator.py --config-name "large_scale/fed_obd/$dataset.yaml"
+done

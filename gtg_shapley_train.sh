@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+# Canonical GTG-Shapley contribution-evaluation workload.
+set -e
+python3 ./simulator.py --config-name gtg_sv/mnist.yaml

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# fed_dropout_avg + fed_paq 1-round smoke.
+set -e
+for algo in fed_dropout_avg fed_paq; do
+  python3 ./simulator.py --config-name "$algo/cifar100.yaml" \
+    ++$algo.round=1 ++$algo.epoch=1 ++$algo.worker_number=2 \
+    ++$algo.algorithm_kwargs.random_client_number=2
+done

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Smoke matrix: one tiny run per modality (CV / NLP / Graph / Shapley / OBD).
+set -e
+
+run() { python3 ./simulator.py "$@"; }
+
+for cfg in fed_avg/mnist fed_avg/imdb; do
+  algo=${cfg%%/*}
+  run --config-name "$cfg.yaml" \
+    ++$algo.round=1 ++$algo.epoch=1 ++$algo.worker_number=2 ++$algo.debug=True
+done
+
+run --config-name fed_gnn/cs.yaml \
+  ++fed_gnn.round=1 ++fed_gnn.epoch=1 ++fed_gnn.worker_number=2
+
+run --config-name gtg_sv/mnist.yaml \
+  ++gtg_sv.round=1 ++gtg_sv.epoch=1 ++gtg_sv.worker_number=2
+
+run --config-name fed_obd/cifar10.yaml \
+  ++fed_obd.round=1 ++fed_obd.epoch=1 ++fed_obd.worker_number=10 \
+  ++fed_obd.algorithm_kwargs.random_client_number=10 \
+  ++fed_obd.algorithm_kwargs.second_phase_epoch=1
