@@ -42,7 +42,19 @@ class HyperParameter:
         total_steps = max(1, total_steps)
         name = (self.learning_rate_scheduler_name or "").lower()
         if name in ("cosineannealinglr", "cosine"):
-            return optax.cosine_decay_schedule(self.learning_rate, decay_steps=total_steps)
+            # torch CosineAnnealingLR parity: the torch formula is PERIODIC in
+            # the step count (optax.cosine_decay_schedule instead clamps to 0
+            # past decay_steps).  The difference only shows when an optimizer
+            # state outlives one schedule span — FedOBD phase 2 'reuse lr'
+            # (method/fed_obd/worker.py) — where clamping froze training.
+            import jax.numpy as jnp
+
+            base = self.learning_rate
+
+            def periodic_cosine(count):
+                return base * 0.5 * (1.0 + jnp.cos(jnp.pi * count / total_steps))
+
+            return periodic_cosine
         if name in ("", "none", "constant", "constantlr"):
             return optax.constant_schedule(self.learning_rate)
         if name in ("linearlr", "linear"):

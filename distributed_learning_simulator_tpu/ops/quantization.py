@@ -74,6 +74,26 @@ def qsgd_quantize_dequantize(x: jnp.ndarray, key: jax.Array, level: int) -> jnp.
     return out.reshape(x.shape).astype(x.dtype)
 
 
+def nnadq_quantize_dequantize(x: jnp.ndarray, weight: float):
+    """NNADQ transport numerics without byte packing (see :class:`NNADQ`):
+    per-tensor adaptive bit-width from tensor stats, deterministic rounding,
+    immediate dequantize.  Returns ``(x_dequantized, bits)`` with ``bits`` a
+    traced scalar — used by the SPMD fed_obd round program where 'transport'
+    is an ICI collective and only the distortion + the analytic payload size
+    matter."""
+    flat = x.astype(jnp.float32).reshape(-1)
+    std = jnp.std(flat)
+    # closed-form bit choice (NNADQ._choose_bits), traced: 2^b = 32 ln2 std/w
+    b = jnp.log2(jnp.maximum(32.0 * math.log(2.0) * std / weight, 1.0) + 1.0)
+    bits = jnp.clip(jnp.round(b), 2, 8)
+    levels = 2.0**bits - 1.0
+    lo = jnp.min(flat)
+    span = jnp.maximum(jnp.max(flat) - lo, 1e-12)
+    q = jnp.round((flat - lo) / span * levels)
+    out = (q / levels * span + lo).reshape(x.shape).astype(x.dtype)
+    return out, bits
+
+
 @functools.partial(jax.jit, static_argnums=(2, 3))
 def _sq_encode_leaf(x: jnp.ndarray, key: jax.Array, level: int, bits: int):
     flat = x.astype(jnp.float32).reshape(-1)

@@ -232,10 +232,14 @@ def _run_task(ctx: TaskContext, return_task_id: bool, task_id: Any) -> dict | An
             session = SpmdFedAvgSession(*session_args, quantization_level=level)
         elif algo == "sign_SGD":
             session = SpmdSignSGDSession(*session_args)
+        elif algo == "fed_obd":
+            from .parallel.spmd_obd import SpmdFedOBDSession
+
+            session = SpmdFedOBDSession(*session_args)
         else:
             raise NotImplementedError(
                 f"no SPMD round program for {algo!r}; supported: "
-                "fed_avg, fed_paq, sign_SGD (use the threaded executor)"
+                "fed_avg, fed_paq, fed_obd, sign_SGD (use the threaded executor)"
             )
         result = session.run()
         get_logger().info("training took %.2f seconds", ctx.timer.elapsed_seconds())

@@ -58,3 +58,41 @@ def test_spmd_sign_sgd():
     assert len(stat["train_loss_per_epoch"]) == 3
     # training loss should not diverge over epochs
     assert stat["train_loss_per_epoch"][-1] <= stat["train_loss_per_epoch"][0] * 1.5
+
+
+def test_spmd_fed_obd():
+    """Two-phase FedOBD as SPMD programs: phase-1 rounds with block dropout
+    + NNADQ wire distortion, then per-epoch phase-2 aggregation."""
+    config = _config(
+        distributed_algorithm="fed_obd",
+        round=2,
+        algorithm_kwargs={
+            "dropout_rate": 0.5,
+            "second_phase_epoch": 2,
+            "random_client_number": 4,
+        },
+        endpoint_kwargs={"worker": {"weight": 0.01}},
+    )
+    result = train(config)
+    # 2 phase-1 rounds + 2 phase-2 epochs recorded
+    assert len(result["performance"]) == 4
+    for key, stat in result["performance"].items():
+        assert np.isfinite(stat["test_loss"])
+        assert stat["received_mb"] > 0
+    # block dropout + <=8-bit codec: wire bytes well under full precision
+    p1 = result["performance"][1]
+    # 4 selected clients × ~0.5 dropout × <=8/32 bits of a ~62KB model
+    assert p1["received_mb"] < 4 * 0.25 * 0.5 * 0.3
+
+
+def test_spmd_fed_obd_matches_threaded_shape():
+    """The SPMD session reports the same stat surface as the threaded path."""
+    config = _config(
+        distributed_algorithm="fed_obd",
+        worker_number=2,
+        round=1,
+        algorithm_kwargs={"dropout_rate": 0.3, "second_phase_epoch": 1},
+    )
+    result = train(config)
+    stat = result["performance"][1]
+    assert {"test_accuracy", "test_loss", "received_mb", "sent_mb"} <= set(stat)
