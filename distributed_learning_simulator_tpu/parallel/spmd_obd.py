@@ -45,10 +45,14 @@ from jax.sharding import PartitionSpec as P
 
 
 class SpmdFedOBDSession(SpmdFedAvgSession):
-    """Two-phase FedOBD with block dropout + NNADQ, one program per phase."""
+    """Two-phase FedOBD with block dropout + quantized transport, one
+    program per phase.  ``codec`` selects the wire numerics: ``"nnadq"``
+    (fed_obd) or ``"qsgd"`` (fed_obd_sq, reference
+    ``method/fed_obd/__init__.py:16-22``)."""
 
-    def __init__(self, *args, **kwargs) -> None:
+    def __init__(self, *args, codec: str = "nnadq", **kwargs) -> None:
         self._phase2_fn = None
+        self._codec = codec
         super().__init__(*args, **kwargs)
 
     # ------------------------------------------------------------------
@@ -77,12 +81,32 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         return self._phase1_fn
 
     def _build_phase_fn(self, phase_two: bool):
+        import math
+
         engine = self.engine
         epochs = 1 if phase_two else self.config.epoch
         weight_cfg = self._nnadq_weight
         block_sizes = jnp.asarray(self._block_sizes)
         block_id = self._block_id
         threshold = (1.0 - self._dropout_rate) * self._total_params
+
+        if self._codec == "qsgd":
+            from ..ops.quantization import qsgd_quantize_dequantize
+
+            level = int(
+                self.config.endpoint_kwargs.get("worker", {}).get(
+                    "quantization_level", 255
+                )
+            )
+            qbits = math.ceil(math.log2(level + 1)) + 1  # level plane + signs
+
+            def qdq(x, key):
+                return qsgd_quantize_dequantize(x, key, level), jnp.float32(qbits)
+
+        else:
+
+            def qdq(x, key):
+                return nnadq_quantize_dequantize(x, weight_cfg)
 
         def keep_mask(local, global_params):
             """Greedy block selection under the parameter budget
@@ -104,6 +128,7 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             return jnp.zeros(block_sizes.shape[0], bool).at[order].set(keep_ord)
 
         def local_train(global_params, data, weight, rng):
+            rng, quant_rng = jax.random.split(rng)
             params = global_params
             opt_state = engine.optimizer.init(params)
 
@@ -125,19 +150,19 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             upload_bits = jnp.float32(0.0)
             if phase_two:
                 # per-epoch full-delta uploads through the codec
-                for k, v in params.items():
+                for i, (k, v) in enumerate(params.items()):
                     delta = v.astype(jnp.float32) - global_params[k].astype(
                         jnp.float32
                     )
-                    dq, bits = nnadq_quantize_dequantize(delta, weight_cfg)
+                    dq, bits = qdq(delta, jax.random.fold_in(quant_rng, i))
                     upload[k] = global_params[k].astype(jnp.float32) + dq
                     upload_bits += bits * v.size
             else:
                 keep = keep_mask(params, global_params)
-                for k, v in params.items():
+                for i, (k, v) in enumerate(params.items()):
                     mask = keep[block_id[k]]
-                    vq, bits = nnadq_quantize_dequantize(
-                        v.astype(jnp.float32), weight_cfg
+                    vq, bits = qdq(
+                        v.astype(jnp.float32), jax.random.fold_in(quant_rng, i)
                     )
                     g = global_params[k].astype(jnp.float32)
                     # complete(): dropped blocks fall back to the old global
@@ -156,8 +181,8 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 mb -= 1
             return mb
 
-        def round_program(global_params, weights, rngs):
-            def shard_body(global_params, data, weights, rngs):
+        def round_program(global_params, weights, rngs, bcast_rng):
+            def shard_body(global_params, data, weights, rngs, bcast_rng):
                 slots_local = weights.shape[0]
                 mb = chunk_size(slots_local)
                 if mb == slots_local:
@@ -229,9 +254,9 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 # codec-distorted global; the exact average stays server-side
                 bcast = {}
                 bcast_bits = jnp.float32(0.0)
-                for k, v in new_global.items():
-                    vq, bits = nnadq_quantize_dequantize(
-                        v.astype(jnp.float32), weight_cfg
+                for i, (k, v) in enumerate(new_global.items()):
+                    vq, bits = qdq(
+                        v.astype(jnp.float32), jax.random.fold_in(bcast_rng, i)
                     )
                     bcast[k] = vq.astype(v.dtype)
                     bcast_bits += bits * v.size
@@ -241,9 +266,9 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             return shard_map_compat(
                 shard_body,
                 self.mesh,
-                in_specs=(P(), P("clients"), P("clients"), P("clients")),
+                in_specs=(P(), P("clients"), P("clients"), P("clients"), P()),
                 out_specs=(P(), P(), P()),
-            )(global_params, self._data, weights, rngs)
+            )(global_params, self._data, weights, rngs, bcast_rng)
 
         return jax.jit(round_program, donate_argnums=(0,))
 
@@ -266,12 +291,12 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
 
         def step(fn, params, weights):
             nonlocal rng
-            rng, round_rng = jax.random.split(rng)
+            rng, round_rng, bcast_rng = jax.random.split(rng, 3)
             client_rngs = jax.device_put(
                 jax.random.split(round_rng, self.n_slots), self._client_sharding
             )
             weights = jax.device_put(weights, self._client_sharding)
-            exact, bcast, metrics = fn(params, weights, client_rngs)
+            exact, bcast, metrics = fn(params, weights, client_rngs, bcast_rng)
             return exact, bcast, {
                 k: float(np.asarray(v)) for k, v in metrics.items()
             }

@@ -232,14 +232,16 @@ def _run_task(ctx: TaskContext, return_task_id: bool, task_id: Any) -> dict | An
             session = SpmdFedAvgSession(*session_args, quantization_level=level)
         elif algo == "sign_SGD":
             session = SpmdSignSGDSession(*session_args)
-        elif algo == "fed_obd":
+        elif algo in ("fed_obd", "fed_obd_sq"):
             from .parallel.spmd_obd import SpmdFedOBDSession
 
-            session = SpmdFedOBDSession(*session_args)
+            session = SpmdFedOBDSession(
+                *session_args, codec="qsgd" if algo == "fed_obd_sq" else "nnadq"
+            )
         else:
             raise NotImplementedError(
-                f"no SPMD round program for {algo!r}; supported: "
-                "fed_avg, fed_paq, fed_obd, sign_SGD (use the threaded executor)"
+                f"no SPMD round program for {algo!r}; supported: fed_avg, "
+                "fed_paq, fed_obd, fed_obd_sq, sign_SGD (use the threaded executor)"
             )
         result = session.run()
         get_logger().info("training took %.2f seconds", ctx.timer.elapsed_seconds())

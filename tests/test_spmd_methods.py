@@ -96,3 +96,18 @@ def test_spmd_fed_obd_matches_threaded_shape():
     result = train(config)
     stat = result["performance"][1]
     assert {"test_accuracy", "test_loss", "received_mb", "sent_mb"} <= set(stat)
+
+
+def test_spmd_fed_obd_sq():
+    """fed_obd_sq: same OBD phases with QSGD wire numerics."""
+    config = _config(
+        distributed_algorithm="fed_obd_sq",
+        round=1,
+        algorithm_kwargs={"dropout_rate": 0.5, "second_phase_epoch": 1},
+        endpoint_kwargs={"worker": {"quantization_level": 255}},
+    )
+    result = train(config)
+    assert len(result["performance"]) == 2
+    for stat in result["performance"].values():
+        assert np.isfinite(stat["test_loss"])
+        assert stat["received_mb"] > 0
