@@ -58,6 +58,8 @@ class ShapleyValueAlgorithm(FedAVGAlgorithm):
                 **self._sv_engine_kwargs(),
             )
         self.sv_algorithm.set_metric_function(self._get_subset_metric)
+        if hasattr(self.sv_algorithm, "set_batch_metric_function"):
+            self.sv_algorithm.set_batch_metric_function(self._get_subset_metrics)
         self.sv_algorithm.compute(round_number=self._server.round_number)
         round_number = self._server.round_number
         self.shapley_values[round_number] = copy.deepcopy(
@@ -88,6 +90,67 @@ class ShapleyValueAlgorithm(FedAVGAlgorithm):
         return self._server.get_metric(worker_data, keep_performance_logger=False)[
             self.metric_type
         ]
+
+    def _get_subset_metrics(self, subsets: list) -> list[float]:
+        """Batched subset metrics: ONE vmapped program aggregates every
+        subset (a 0/1 worker mask) and runs central inference on all of them
+        concurrently — vs the reference's one full test inference per subset
+        per round (``shapley_value_algorithm.py:67-76``, SURVEY.md §3.3 'HOT')."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ...engine.batching import make_epoch_batches
+        from ...ml_type import MachineLearningPhase as Phase
+
+        workers = sorted(self._all_worker_data)
+        data = self._all_worker_data
+        weights = jnp.asarray(
+            [float(data[w].dataset_size) for w in workers], jnp.float32
+        )
+        stacked = {
+            k: jnp.stack(
+                [jnp.asarray(data[w].parameter[k], jnp.float32) for w in workers]
+            )
+            for k in data[workers[0]].parameter
+        }
+        engine = self._server.tester.engine
+        test = self._server.tester.dataset_collection.get_dataset(Phase.Test)
+        batches = make_epoch_batches(test, self.config.batch_size)
+
+        chunk = 16  # bound live memory at chunk × model params
+
+        @jax.jit
+        def eval_masks(masks):
+            def agg_one(mask):
+                w = mask * weights
+                tw = jnp.maximum(jnp.sum(w), 1e-12)
+                return {
+                    k: jnp.einsum("w,w...->...", w, v) / tw
+                    for k, v in stacked.items()
+                }
+
+            params = jax.vmap(agg_one)(masks)
+            return jax.vmap(lambda p: engine.eval_fn(p, batches))(params)
+
+        results: list[float] = []
+        masks = np.asarray(
+            [[1.0 if w in set(s) else 0.0 for w in workers] for s in subsets],
+            np.float32,
+        )
+        for start in range(0, len(subsets), chunk):
+            part = masks[start : start + chunk]
+            if part.shape[0] < chunk:  # pad for a single compiled shape
+                part = np.pad(part, ((0, chunk - part.shape[0]), (0, 0)))
+                part[len(masks) - start :, 0] = 1.0  # avoid all-zero masks
+            out = eval_masks(jnp.asarray(part))
+            correct = np.asarray(out["correct"])
+            count = np.maximum(np.asarray(out["count"]), 1.0)
+            loss = np.asarray(out["loss_sum"]) / count
+            acc = correct / count
+            values = loss if self.metric_type == "loss" else acc
+            results.extend(float(v) for v in values[: len(masks) - start])
+        return results[: len(subsets)]
 
     def exit(self) -> None:
         if self.sv_algorithm is None:

@@ -62,6 +62,30 @@ class ShapleyValueEngine:
     def set_metric_function(self, fn: Callable[[Iterable], float]) -> None:
         self.metric_fn = fn
 
+    def set_batch_metric_function(self, fn: Callable[[list], list]) -> None:
+        """Optional fast path: evaluate MANY subsets in one call (the
+        framework vmaps subset-aggregation + central inference into one
+        program — SURVEY.md §7 hard-part 4 'batch subset evals')."""
+        self.batch_metric_fn = fn
+
+    def _metric_many(self, subsets: Iterable[Iterable]) -> None:
+        """Populate the cache for all ``subsets`` at once when a batch
+        metric is available; falls back to sequential calls."""
+        missing = sorted(
+            {frozenset(s) for s in subsets if s} - set(self._cache),
+            key=sorted,
+        )
+        if not missing:
+            return
+        batch_fn = getattr(self, "batch_metric_fn", None)
+        if batch_fn is None:
+            for subset in missing:
+                self._metric(subset)
+            return
+        values = batch_fn([tuple(sorted(s)) for s in missing])
+        for subset, value in zip(missing, values):
+            self._cache[subset] = float(value)
+
     def _metric(self, subset: Iterable) -> float:
         key = frozenset(subset)
         if not key:

@@ -4,7 +4,7 @@ enumeration for small player counts, Monte-Carlo permutations otherwise
 
 import numpy as np
 
-from .base import ShapleyValueEngine, exact_shapley, monte_carlo_shapley
+from .base import ShapleyValueEngine, exact_shapley
 
 
 class MultiRoundShapleyValue(ShapleyValueEngine):
@@ -33,8 +33,32 @@ class MultiRoundShapleyValue(ShapleyValueEngine):
         self._finish_round(round_number, sv)
 
     def _exact(self, players: list) -> dict:
+        # all 2^n - 1 coalition metrics are known upfront — evaluate them as
+        # one batched program instead of 2^n sequential aggregate+infer runs
+        import itertools
+
+        self._metric_many(
+            set(subset)
+            for r in range(1, len(players) + 1)
+            for subset in itertools.combinations(players, r)
+        )
         return exact_shapley(players, self._metric)
 
     def _monte_carlo(self, players: list) -> dict:
         n_perms = self.mc_permutations or max(2 * len(players), 30)
-        return monte_carlo_shapley(players, self._metric, n_perms, self._rng)
+        # plain (non-truncated) permutation sampling touches every prefix of
+        # every sampled permutation — also batchable upfront
+        perms = [list(self._rng.permutation(players)) for _ in range(n_perms)]
+        self._metric_many(
+            {frozenset(perm[: i + 1]) for perm in perms for i in range(len(perm))}
+        )
+        contributions = {p: 0.0 for p in players}
+        for perm in perms:
+            prefix: set = set()
+            prev = self._metric(prefix) if prefix else self.last_round_metric
+            for player in perm:
+                prefix = prefix | {player}
+                current = self._metric(prefix)
+                contributions[player] += current - prev
+                prev = current
+        return {p: v / n_perms for p, v in contributions.items()}

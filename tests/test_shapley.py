@@ -56,3 +56,63 @@ def test_gtg_between_round_truncation():
     engine.compute(round_number=2)
     assert engine.shapley_values[2] == {p: 0.0 for p in VALUES}
     assert len(calls) == 1  # only the full-coalition check
+
+
+def test_batch_metric_path_matches_sequential():
+    """set_batch_metric_function populates the cache with the same values
+    the per-subset callback would produce (exact and MC paths)."""
+    calls = {"batch": 0, "single": 0}
+
+    def batch_metric(subsets):
+        calls["batch"] += 1
+        return [metric(s) for s in subsets]
+
+    def single_metric(subset):
+        calls["single"] += 1
+        return metric(subset)
+
+    batched = MultiRoundShapleyValue(players=list(VALUES), last_round_metric=BASE)
+    batched.set_metric_function(single_metric)
+    batched.set_batch_metric_function(batch_metric)
+    batched.compute(round_number=1)
+
+    plain = MultiRoundShapleyValue(players=list(VALUES), last_round_metric=BASE)
+    plain.set_metric_function(metric)
+    plain.compute(round_number=1)
+
+    assert batched.shapley_values[1] == plain.shapley_values[1]
+    assert calls["batch"] == 1  # one program for all 2^n - 1 subsets
+    assert calls["single"] == 0  # sequential path never used
+
+
+def test_batch_metric_monte_carlo_path():
+    players = list(range(10))  # > exact_player_limit forces the MC path
+    values = {p: 0.01 * (p + 1) for p in players}
+
+    def game(subset):
+        return sum(values[p] for p in subset)
+
+    engine = MultiRoundShapleyValue(
+        players=players, last_round_metric=0.0, mc_permutations=200, seed=7
+    )
+    engine.set_metric_function(game)
+    engine.set_batch_metric_function(lambda subsets: [game(s) for s in subsets])
+    engine.compute(round_number=1)
+    sv = engine.shapley_values[1]
+    for p in players:  # additive game ⇒ MC estimate is exact per permutation
+        assert sv[p] == pytest.approx(values[p], abs=1e-9)
+
+
+def test_gtg_batch_path_same_sv():
+    """GTG with a batch evaluator reproduces the sequential SVs exactly
+    (truncation decisions are replayed from the batched values)."""
+    seq = GTGShapleyValue(players=list(VALUES), last_round_metric=BASE, seed=3)
+    seq.set_metric_function(metric)
+    seq.compute(round_number=1)
+
+    bat = GTGShapleyValue(players=list(VALUES), last_round_metric=BASE, seed=3)
+    bat.set_metric_function(metric)
+    bat.set_batch_metric_function(lambda subsets: [metric(s) for s in subsets])
+    bat.compute(round_number=1)
+
+    assert bat.shapley_values[1] == seq.shapley_values[1]
