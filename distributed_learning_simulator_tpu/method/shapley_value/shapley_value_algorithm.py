@@ -120,8 +120,10 @@ class ShapleyValueAlgorithm(FedAVGAlgorithm):
 
         chunk = 16  # bound live memory at chunk × model params
 
+        # stacked params / test batches enter as arguments — closing over
+        # them would bake the arrays into the HLO as constants
         @jax.jit
-        def eval_masks(masks):
+        def eval_masks(masks, stacked, weights, batches):
             def agg_one(mask):
                 w = mask * weights
                 tw = jnp.maximum(jnp.sum(w), 1e-12)
@@ -143,7 +145,7 @@ class ShapleyValueAlgorithm(FedAVGAlgorithm):
             if part.shape[0] < chunk:  # pad for a single compiled shape
                 part = np.pad(part, ((0, chunk - part.shape[0]), (0, 0)))
                 part[len(masks) - start :, 0] = 1.0  # avoid all-zero masks
-            out = eval_masks(jnp.asarray(part))
+            out = eval_masks(jnp.asarray(part), stacked, weights, batches)
             correct = np.asarray(out["correct"])
             count = np.maximum(np.asarray(out["count"]), 1.0)
             loss = np.asarray(out["loss_sum"]) / count

@@ -202,9 +202,11 @@ class SpmdFedAvgSession:
                 mb -= 1
             return mb
 
-        def round_program(global_params, weights, rngs):
+        def round_program(global_params, weights, rngs, data):
             """shard_map body: scan client chunks, vmap inside each, psum
-            the reduction."""
+            the reduction.  ``data`` is an explicit argument — closing over
+            the stacked client arrays would bake them into the HLO as
+            constants (hundreds of MB of program, slow/oversized compiles)."""
 
             def shard_body(global_params, data, weights, rngs):
                 slots_local = weights.shape[0]
@@ -276,11 +278,16 @@ class SpmdFedAvgSession:
                 self.mesh,
                 in_specs=(P(), P("clients"), P("clients"), P("clients")),
                 out_specs=(P(), P()),
-            )(global_params, self._data, weights, rngs)
+            )(global_params, data, weights, rngs)
 
         # donate the old global params: the round returns the new ones, so
         # XLA can reuse the buffer instead of holding both copies live
-        return jax.jit(round_program, donate_argnums=(0,))
+        jitted = jax.jit(round_program, donate_argnums=(0,))
+
+        def fn(global_params, weights, rngs):
+            return jitted(global_params, weights, rngs, self._data)
+
+        return fn
 
     # ------------------------------------------------------------------
     def _select_weights(self, round_number: int) -> np.ndarray:
@@ -457,15 +464,21 @@ class SpmdSignSGDSession:
             )
             return params, epoch_metrics
 
-        def run_program(params, weights, rngs):
+        def run_program(params, weights, rngs, data):
             return shard_map_compat(
                 shard_body,
                 self.mesh,
                 in_specs=(P(), P(None, "clients"), P("clients"), P("clients")),
                 out_specs=(P(), P()),
-            )(params, self._data, weights, rngs)
+            )(params, data, weights, rngs)
 
-        return jax.jit(run_program)
+        # data as an argument, not a closure constant (see _build_round_fn)
+        jitted = jax.jit(run_program, donate_argnums=(0,))
+
+        def fn(params, weights, rngs):
+            return jitted(params, weights, rngs, self._data)
+
+        return fn
 
     def run(self) -> dict:
         config = self.config

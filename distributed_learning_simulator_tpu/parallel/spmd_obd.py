@@ -181,7 +181,7 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 mb -= 1
             return mb
 
-        def round_program(global_params, weights, rngs, bcast_rng):
+        def round_program(global_params, weights, rngs, bcast_rng, data):
             def shard_body(global_params, data, weights, rngs, bcast_rng):
                 slots_local = weights.shape[0]
                 mb = chunk_size(slots_local)
@@ -268,9 +268,15 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 self.mesh,
                 in_specs=(P(), P("clients"), P("clients"), P("clients"), P()),
                 out_specs=(P(), P(), P()),
-            )(global_params, self._data, weights, rngs, bcast_rng)
+            )(global_params, data, weights, rngs, bcast_rng)
 
-        return jax.jit(round_program, donate_argnums=(0,))
+        # data as an argument, not a closure constant (see spmd.py)
+        jitted = jax.jit(round_program, donate_argnums=(0,))
+
+        def fn(global_params, weights, rngs, bcast_rng):
+            return jitted(global_params, weights, rngs, bcast_rng, self._data)
+
+        return fn
 
     # ------------------------------------------------------------------
     def _all_weights(self) -> np.ndarray:

@@ -1,4 +1,9 @@
 from .gtg_shapley_value import GTGShapleyValue
+from .hierarchical_shapley_value import HierarchicalShapleyValue
 from .multiround_shapley_value import MultiRoundShapleyValue
 
-__all__ = ["GTGShapleyValue", "MultiRoundShapleyValue"]
+__all__ = [
+    "GTGShapleyValue",
+    "HierarchicalShapleyValue",
+    "MultiRoundShapleyValue",
+]

@@ -72,6 +72,33 @@ class HierarchicalShapleyValue(ShapleyValueEngine):
 
     def compute(self, round_number: int) -> None:
         group_ids = list(range(self.part_number))
+        if getattr(self, "batch_metric_fn", None) is not None:
+            # pre-evaluate every coalition the exact passes below will ask
+            # for — one batched aggregate+infer program instead of
+            # 2^part_number + Σ_g 2^|g| sequential ones
+            import itertools
+
+            wanted: list[set] = []
+            if self.part_number <= self.exact_group_limit:
+                for r in range(1, self.part_number + 1):
+                    for combo in itertools.combinations(group_ids, r):
+                        members: set = set()
+                        for g in combo:
+                            members.update(self.groups[g])
+                        wanted.append(members)
+            for g in group_ids:
+                rest = {
+                    p
+                    for other in group_ids
+                    if other != g
+                    for p in self.groups[other]
+                }
+                for r in range(len(self.groups[g]) + 1):
+                    for combo in itertools.combinations(self.groups[g], r):
+                        subset = rest | set(combo)
+                        if subset:
+                            wanted.append(subset)
+            self._metric_many(wanted)
 
         def group_metric(group_subset) -> float:
             members: set = set()

@@ -116,3 +116,27 @@ def test_gtg_batch_path_same_sv():
     bat.compute(round_number=1)
 
     assert bat.shapley_values[1] == seq.shapley_values[1]
+
+
+def test_hierarchical_batch_path_same_sv():
+    from distributed_learning_simulator_tpu.shapley import HierarchicalShapleyValue
+
+    players = list(range(6))
+    values = {p: 0.02 * (p + 1) for p in players}
+
+    def game(subset):
+        return sum(values[p] for p in subset)
+
+    def make(batch):
+        engine = HierarchicalShapleyValue(
+            players, last_round_metric=0.0, part_number=2, seed=5
+        )
+        engine.set_metric_function(game)
+        if batch:
+            engine.set_batch_metric_function(
+                lambda subsets: [game(s) for s in subsets]
+            )
+        engine.compute(round_number=1)
+        return engine.shapley_values[1]
+
+    assert make(batch=True) == make(batch=False)
