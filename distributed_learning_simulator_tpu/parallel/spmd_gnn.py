@@ -1,0 +1,375 @@
+"""Federated GNN (fed_gnn / fed_gcn) as one SPMD program per round.
+
+The reference's graph FL performs a synchronous boundary-embedding exchange
+through the server **inside every forward pass** — N workers post to pipes
+and block until the server routes embeddings back
+(``graph_worker.py:344-373``, SURVEY.md §3.4: "a synchronous barrier across
+all workers per message-passing layer per batch").  On the mesh this whole
+barrier is ONE collective: every client slot computes its first-layer
+embeddings, the provided rows (each training node has exactly one owner, so
+owner masks are disjoint) are summed across slots and ``psum``-ed over the
+``clients`` axis into a global embedding table, and each slot's second layer
+reads its boundary rows from that table — ``stop_gradient``-ed, matching the
+reference's detached pipe tensors.  Epochs × exchanges × the weighted FedAvg
+reduction compile into a single XLA program; the host keeps rounds, records,
+and artifacts.
+
+Partitioning parity with the threaded ``worker/graph_worker.py``: per-client
+in-client edge masks for layer 0, in-client + surviving cross-training edges
+(after ``edge_drop_rate``) for later layers, boundary/provide node sets, and
+per-round byte accounting from the same mask counts.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..engine.batching import make_graph_batch
+from ..engine.engine import summarize_metrics
+from ..ml_type import MachineLearningPhase as Phase
+from ..models.registry import masked_ce_loss
+from ..ops.pytree import unflatten_nested
+from ..utils.logging import get_logger
+from .mesh import client_slots, make_mesh
+from .spmd import shard_map_compat
+
+
+class SpmdFedGNNSession:
+    def __init__(
+        self,
+        config,
+        dataset_collection,
+        model_ctx,
+        engine,
+        practitioners,
+        mesh=None,
+        share_feature: bool | None = None,
+    ) -> None:
+        self.config = config
+        self.dc = dataset_collection
+        self.model_ctx = model_ctx
+        self.engine = engine
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_slots = client_slots(config.worker_number, self.mesh)
+        self._share_feature = (
+            config.algorithm_kwargs.get("share_feature", True)
+            if share_feature is None
+            else share_feature
+        )
+        self._stat: dict[int, dict] = {}
+        self._max_acc = 0.0
+        self._prepare_data(practitioners)
+        self._round_fn = self._build_round_fn()
+
+    # ------------------------------------------------------------------
+    def _prepare_data(self, practitioners) -> None:
+        config = self.config
+        train = self.dc.get_dataset(Phase.Training)
+        graph = train.inputs
+        num_nodes = len(train.targets)
+        edge_index = np.asarray(graph["edge_index"])
+        src, dst = edge_index[0], edge_index[1]
+        drop_rate = float(config.algorithm_kwargs.get("edge_drop_rate", 0.0))
+
+        own_lists: list[np.ndarray] = []
+        for practitioner in sorted(practitioners, key=lambda p: p.worker_id):
+            sampler = practitioner.get_sampler(config.dataset_name)
+            idx = sampler.sample(practitioner.practitioner_id)[Phase.Training]
+            own_lists.append(np.asarray(idx, np.int64))
+
+        S = self.n_slots
+        own_mask = np.zeros((S, num_nodes), np.float32)
+        local_edges = np.zeros((S, src.shape[0]), np.float32)
+        cross_edges = np.zeros_like(local_edges)
+        provide_mask = np.zeros_like(own_mask)
+        boundary_mask = np.zeros_like(own_mask)
+        train_mask = np.zeros_like(own_mask)
+        sizes = np.zeros(S, np.float32)
+
+        all_training = np.zeros(num_nodes, bool)
+        for idx in own_lists:
+            all_training[idx] = True
+        for c, idx in enumerate(own_lists):
+            own = np.zeros(num_nodes, bool)
+            own[idx] = True
+            other_training = all_training & ~own
+            in_client = own[src] & own[dst]
+            cross = (own[src] & other_training[dst]) | (
+                other_training[src] & own[dst]
+            )
+            if drop_rate > 0:
+                # same per-worker stream as the threaded GraphWorker
+                rng = np.random.default_rng(config.seed * 131 + c)
+                cross &= rng.random(cross.shape) >= drop_rate
+            own_mask[c, own] = 1.0
+            local_edges[c] = in_client
+            cross_edges[c] = in_client | cross
+            prov = np.unique(
+                np.concatenate([src[cross & own[src]], dst[cross & own[dst]]])
+            )
+            bnd = np.unique(
+                np.concatenate(
+                    [
+                        src[cross & other_training[src]],
+                        dst[cross & other_training[dst]],
+                    ]
+                )
+            )
+            provide_mask[c, prov.astype(np.int64)] = 1.0
+            boundary_mask[c, bnd.astype(np.int64)] = 1.0
+            train_mask[c, own] = 1.0
+            sizes[c] = len(idx)
+
+        # a slot only receives rows someone actually provides
+        provided_any = provide_mask.max(axis=0)
+        recv_mask = boundary_mask * provided_any[None, :]
+
+        self._dataset_sizes = sizes
+        hidden = int(getattr(self.model_ctx.module, "hidden", 64))
+        steps = config.epoch  # full-batch: one exchange per epoch
+        self._round_payload_bytes = int(
+            steps * 4 * hidden * (provide_mask.sum() + recv_mask.sum())
+        )
+        if not self._share_feature:
+            cross_edges = local_edges.copy()
+            recv_mask = np.zeros_like(recv_mask)
+            self._round_payload_bytes = 0
+
+        client_sharding = NamedSharding(self.mesh, P("clients"))
+        replicated = NamedSharding(self.mesh, P())
+        self._client_sharding = client_sharding
+        self._replicated = replicated
+        self._data = {
+            "local_edges": jax.device_put(local_edges, client_sharding),
+            "cross_edges": jax.device_put(cross_edges, client_sharding),
+            "provide": jax.device_put(provide_mask, client_sharding),
+            "recv": jax.device_put(recv_mask, client_sharding),
+            "train_mask": jax.device_put(train_mask, client_sharding),
+            "x": jax.device_put(np.asarray(graph["x"], np.float32), replicated),
+            "edge_index": jax.device_put(edge_index, replicated),
+            "targets": jax.device_put(
+                np.asarray(train.targets, np.int32), replicated
+            ),
+        }
+
+    # ------------------------------------------------------------------
+    def _build_round_fn(self):
+        engine = self.engine
+        model = self.model_ctx.module
+        epochs = self.config.epoch
+        share_feature = self._share_feature
+
+        def apply_embed(params, inputs, train, rng):
+            variables = {"params": unflatten_nested(params)}
+            return model.apply(
+                variables,
+                inputs,
+                train=train,
+                method=model.embed,
+                rngs={"dropout": rng} if train else None,
+            )
+
+        def apply_head(params, h, inputs, rng):
+            variables = {"params": unflatten_nested(params)}
+            return model.apply(
+                variables,
+                h,
+                inputs,
+                train=True,
+                method=model.head,
+                rngs={"dropout": rng},
+            )
+
+        def round_program(global_params, weights, rngs, data):
+            def shard_body(global_params, data, weights, rngs):
+                S = weights.shape[0]
+                x = data["x"]
+                edge_index = data["edge_index"]
+                targets = data["targets"]
+
+                params0 = jax.tree.map(
+                    lambda p: jnp.broadcast_to(p, (S, *p.shape)), global_params
+                )
+                opt0 = jax.vmap(engine.optimizer.init)(params0)
+
+                def inputs_for(edge_mask):
+                    return {
+                        "x": x,
+                        "edge_index": edge_index,
+                        "edge_mask": edge_mask,
+                    }
+
+                def epoch_body(carry, epoch_rngs):
+                    params_s, opt_s = carry
+                    if share_feature:
+                        # the reference's through-server exchange, as one
+                        # collective: disjoint owner masks sum into a global
+                        # embedding table
+                        h_pay = jax.vmap(
+                            lambda p, lm: apply_embed(
+                                p, inputs_for(lm), False, None
+                            )
+                        )(params_s, data["local_edges"])
+                        provide_sum = jnp.einsum(
+                            "sn,snh->nh", data["provide"], h_pay
+                        )
+                        table = jax.lax.stop_gradient(
+                            jax.lax.psum(provide_sum, axis_name="clients")
+                        )
+                    else:
+                        table = None
+
+                    def slot_step(p, o, lm, cm, rm, tm, rng):
+                        def loss_fn(p):
+                            h_local = apply_embed(p, inputs_for(lm), True, rng)
+                            if table is not None:
+                                h = (
+                                    h_local * (1.0 - rm[:, None])
+                                    + table * rm[:, None]
+                                )
+                            else:
+                                h = h_local
+                            logits = apply_head(p, h, inputs_for(cm), rng)
+                            return masked_ce_loss(logits, targets, tm)
+
+                        (loss, aux), grads = jax.value_and_grad(
+                            loss_fn, has_aux=True
+                        )(p)
+                        updates, o = engine.optimizer.update(grads, o, p)
+                        p = optax.apply_updates(p, updates)
+                        metrics = {
+                            "loss_sum": loss * aux["count"],
+                            "correct": aux["correct"],
+                            "count": aux["count"],
+                        }
+                        return p, o, metrics
+
+                    params_s, opt_s, metrics = jax.vmap(slot_step)(
+                        params_s,
+                        opt_s,
+                        data["local_edges"],
+                        data["cross_edges"],
+                        data["recv"],
+                        data["train_mask"],
+                        epoch_rngs,
+                    )
+                    return (params_s, opt_s), metrics
+
+                epoch_rngs = jax.vmap(
+                    lambda r: jax.random.split(r, epochs)
+                )(rngs).swapaxes(0, 1)  # [E, S, 2]
+                (params_s, _), metrics = jax.lax.scan(
+                    epoch_body, (params0, opt0), epoch_rngs
+                )
+
+                contrib = jax.tree.map(
+                    lambda ps: jnp.einsum(
+                        "s,s...->...", weights, ps.astype(jnp.float32)
+                    ),
+                    params_s,
+                )
+                global_sum = jax.tree.map(
+                    lambda c: jax.lax.psum(c, axis_name="clients"), contrib
+                )
+                total_weight = jax.lax.psum(jnp.sum(weights), axis_name="clients")
+                new_global = jax.tree.map(
+                    lambda s, g: (s / jnp.maximum(total_weight, 1e-12)).astype(
+                        g.dtype
+                    ),
+                    global_sum,
+                    global_params,
+                )
+                metrics = jax.tree.map(
+                    lambda m: jax.lax.psum(jnp.sum(m), axis_name="clients"),
+                    metrics,
+                )
+                return new_global, metrics
+
+            return shard_map_compat(
+                shard_body,
+                self.mesh,
+                in_specs=(
+                    P(),
+                    {
+                        "local_edges": P("clients"),
+                        "cross_edges": P("clients"),
+                        "provide": P("clients"),
+                        "recv": P("clients"),
+                        "train_mask": P("clients"),
+                        "x": P(),
+                        "edge_index": P(),
+                        "targets": P(),
+                    },
+                    P("clients"),
+                    P("clients"),
+                ),
+                out_specs=(P(), P()),
+            )(global_params, data, weights, rngs)
+
+        jitted = jax.jit(round_program, donate_argnums=(0,))
+
+        def fn(global_params, weights, rngs):
+            return jitted(global_params, weights, rngs, self._data)
+
+        return fn
+
+    # ------------------------------------------------------------------
+    def run(self) -> dict:
+        config = self.config
+        save_dir = os.path.join(config.save_dir, "server")
+        os.makedirs(save_dir, exist_ok=True)
+        global_params = jax.device_put(
+            self.engine.init_params(config.seed), self._replicated
+        )
+        weights = jax.device_put(
+            self._dataset_sizes, self._client_sharding
+        )
+        rng = jax.random.PRNGKey(config.seed)
+        test_batch = make_graph_batch(self.dc.get_dataset(Phase.Test))
+        for round_number in range(1, config.round + 1):
+            rng, round_rng = jax.random.split(rng)
+            client_rngs = jax.device_put(
+                jax.random.split(round_rng, self.n_slots), self._client_sharding
+            )
+            global_params, train_metrics = self._round_fn(
+                global_params, weights, client_rngs
+            )
+            metric = summarize_metrics(
+                self.engine.evaluate_single(global_params, test_batch)
+            )
+            mb = self._round_payload_bytes / 1e6
+            self._stat[round_number] = {
+                "test_accuracy": metric["accuracy"],
+                "test_loss": metric["loss"],
+                "test_count": metric["count"],
+                "received_mb": mb,
+                "sent_mb": mb,
+            }
+            get_logger().info(
+                "round: %d, test accuracy %.4f loss %.4f (spmd gnn, %.3f MB exchanged)",
+                round_number,
+                metric["accuracy"],
+                metric["loss"],
+                mb,
+            )
+            import json
+
+            with open(
+                os.path.join(save_dir, "round_record.json"), "wt", encoding="utf8"
+            ) as f:
+                json.dump(self._stat, f)
+            if metric["accuracy"] > self._max_acc:
+                self._max_acc = metric["accuracy"]
+                np.savez(
+                    os.path.join(save_dir, "best_global_model.npz"),
+                    **{k: np.asarray(v) for k, v in global_params.items()},
+                )
+        return {"performance": self._stat}
+
+    @property
+    def performance_stat(self) -> dict:
+        return self._stat

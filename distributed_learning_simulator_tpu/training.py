@@ -238,10 +238,18 @@ def _run_task(ctx: TaskContext, return_task_id: bool, task_id: Any) -> dict | An
             session = SpmdFedOBDSession(
                 *session_args, codec="qsgd" if algo == "fed_obd_sq" else "nnadq"
             )
+        elif algo in ("fed_gnn", "fed_gcn"):
+            from .parallel.spmd_gnn import SpmdFedGNNSession
+
+            session = SpmdFedGNNSession(
+                *session_args,
+                share_feature=True if algo == "fed_gcn" else None,
+            )
         else:
             raise NotImplementedError(
                 f"no SPMD round program for {algo!r}; supported: fed_avg, "
-                "fed_paq, fed_obd, fed_obd_sq, sign_SGD (use the threaded executor)"
+                "fed_paq, fed_obd, fed_obd_sq, fed_gnn, fed_gcn, sign_SGD "
+                "(use the threaded executor)"
             )
         result = session.run()
         get_logger().info("training took %.2f seconds", ctx.timer.elapsed_seconds())

@@ -111,3 +111,46 @@ def test_spmd_fed_obd_sq():
     for stat in result["performance"].values():
         assert np.isfinite(stat["test_loss"])
         assert stat["received_mb"] > 0
+
+
+def _gnn_config(**kwargs):
+    base = dict(
+        dataset_name="Cora",
+        model_name="TwoGCN",
+        worker_number=4,
+        round=2,
+        epoch=2,
+        learning_rate=0.01,
+        executor="spmd",
+        algorithm_kwargs={"share_feature": True, "edge_drop_rate": 0.2},
+    )
+    base.update(kwargs)
+    return DistributedTrainingConfig(**base)
+
+
+def test_spmd_fed_gnn():
+    """Boundary-embedding exchange as an in-program psum: the whole round
+    (epochs x exchanges + FedAvg) is one XLA program."""
+    result = train(_gnn_config(distributed_algorithm="fed_gnn"))
+    assert len(result["performance"]) == 2
+    for stat in result["performance"].values():
+        assert np.isfinite(stat["test_loss"])
+        assert stat["received_mb"] > 0  # embeddings actually exchanged
+
+
+def test_spmd_fed_gnn_no_share():
+    result = train(
+        _gnn_config(
+            distributed_algorithm="fed_gnn",
+            algorithm_kwargs={"share_feature": False},
+        )
+    )
+    assert result["performance"][1]["received_mb"] == 0
+
+
+def test_spmd_fed_gcn_learns():
+    """fed_gcn (feature sharing forced) improves over rounds on the
+    synthetic citation graph."""
+    result = train(_gnn_config(distributed_algorithm="fed_gcn", round=4))
+    accs = [result["performance"][r]["test_accuracy"] for r in (1, 4)]
+    assert accs[-1] >= accs[0] - 0.05
