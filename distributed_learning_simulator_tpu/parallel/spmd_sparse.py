@@ -1,0 +1,251 @@
+"""Sparse-transport methods as SPMD round programs.
+
+* ``fed_dropout_avg`` (reference ``method/fed_dropout_avg``): per-element
+  Bernoulli dropout of the uploaded parameters; aggregation divides the
+  masked weighted sum by the per-element surviving weight — here two psums
+  (numerator and per-element denominator) over the ``clients`` axis.
+* ``single_model_afd`` (reference ``method/smafd`` building blocks,
+  ``ErrorFeedbackWorker`` + ``RandomDropoutAlgorithm``): error-feedback
+  sparsified delta uploads.  The per-client residual is a device-resident
+  state carried across rounds through the program — no host round-trips.
+  ``topk_ratio`` selects magnitude thresholding (per-tensor k-th value via
+  ``lax.top_k``; ties can admit a few extra elements — the threaded path's
+  native ``nth_element`` picker stays exact); otherwise random whole-tensor
+  dropout under the ``1-dropout_rate`` parameter budget, matching
+  ``RandomDropoutAlgorithm``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .spmd import SpmdFedAvgSession, shard_map_compat
+
+
+class SpmdFedDropoutAvgSession(SpmdFedAvgSession):
+    def _build_round_fn(self):
+        engine = self.engine
+        epochs = self.config.epoch
+        dropout_rate = float(self.config.algorithm_kwargs["dropout_rate"])
+
+        def local_train(global_params, data, weight, rng):
+            rng, drop_rng = jax.random.split(rng)
+            params = global_params
+            opt_state = engine.optimizer.init(params)
+
+            def epoch_body(carry, epoch_rng):
+                params, opt_state = carry
+                params, opt_state, metrics = engine.train_epoch_fn(
+                    params, opt_state, data, epoch_rng
+                )
+                return (params, opt_state), metrics
+
+            (params, _), metrics = jax.lax.scan(
+                epoch_body,
+                (params, opt_state),
+                jax.random.split(rng, epochs),
+            )
+            summed = jax.tree.map(lambda x: jnp.sum(x), metrics)
+
+            num, den = {}, {}
+            send_num = jnp.float32(0.0)
+            for i, (k, v) in enumerate(params.items()):
+                keep = jax.random.bernoulli(
+                    jax.random.fold_in(drop_rng, i),
+                    p=1.0 - dropout_rate,
+                    shape=v.shape,
+                ).astype(jnp.float32)
+                dropped = v.astype(jnp.float32) * keep
+                # aggregation weight = (element survived) × dataset size
+                # (reference ``fed_dropout_avg/algorithm.py:8-19``; a zero
+                # PARAMETER VALUE also zeroes the weight there — the `!= 0`
+                # test cannot tell a dropped element from a zero one)
+                elem_w = (dropped != 0).astype(jnp.float32) * weight
+                num[k] = dropped * elem_w
+                den[k] = elem_w
+                send_num += jnp.sum(keep) * (weight > 0)
+            summed = dict(summed, send_num=send_num)
+            return {"num": num, "den": den}, summed
+
+        def round_program(global_params, weights, rngs, data):
+            def shard_body(global_params, data, weights, rngs):
+                contributions, metrics = jax.vmap(
+                    local_train, in_axes=(None, 0, 0, 0)
+                )(global_params, data, weights, rngs)
+                local_sum = jax.tree.map(
+                    lambda c: jnp.sum(c, axis=0), contributions
+                )
+                global_sum = jax.tree.map(
+                    lambda s: jax.lax.psum(s, axis_name="clients"), local_sum
+                )
+                new_global = {
+                    k: (
+                        global_sum["num"][k]
+                        / jnp.where(
+                            global_sum["den"][k] == 0, 1.0, global_sum["den"][k]
+                        )
+                    ).astype(global_params[k].dtype)
+                    for k in global_params
+                }
+                metrics = jax.tree.map(
+                    lambda m: jax.lax.psum(jnp.sum(m), axis_name="clients"),
+                    metrics,
+                )
+                return new_global, metrics
+
+            return shard_map_compat(
+                shard_body,
+                self.mesh,
+                in_specs=(P(), P("clients"), P("clients"), P("clients")),
+                out_specs=(P(), P()),
+            )(global_params, data, weights, rngs)
+
+        jitted = jax.jit(round_program, donate_argnums=(0,))
+
+        def fn(global_params, weights, rngs):
+            return jitted(global_params, weights, rngs, self._data)
+
+        return fn
+
+
+class SpmdSMAFDSession(SpmdFedAvgSession):
+    """single_model_afd: error-feedback sparsified delta uploads with the
+    residual state living on device across rounds."""
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        err0 = jax.tree.map(
+            lambda p: np.zeros((self.n_slots, *p.shape), np.float32),
+            self.engine.init_params(self.config.seed),
+        )
+        self._err_state = jax.device_put(
+            err0, NamedSharding(self.mesh, P("clients"))
+        )
+
+    def _build_round_fn(self):
+        engine = self.engine
+        epochs = self.config.epoch
+        kwargs = self.config.algorithm_kwargs
+        topk_ratio = kwargs.get("topk_ratio")
+        dropout_rate = float(kwargs.get("dropout_rate", 0.0))
+
+        def sparsify(delta, rng):
+            """Returns (sent, send_num)."""
+            if topk_ratio is not None:
+                sent = {}
+                send_num = jnp.float32(0.0)
+                for k, v in delta.items():
+                    flat = v.reshape(-1)
+                    kth = max(1, int(flat.size * float(topk_ratio)))
+                    thresh = jax.lax.top_k(jnp.abs(flat), kth)[0][-1]
+                    mask = (jnp.abs(v) >= thresh).astype(jnp.float32)
+                    sent[k] = v * mask
+                    send_num += jnp.sum(mask)
+                return sent, send_num
+            # random whole-tensor dropout under the parameter budget
+            # (RandomDropoutAlgorithm semantics)
+            names = list(delta)
+            sizes = jnp.asarray(
+                [float(delta[k].size) for k in names], jnp.float32
+            )
+            threshold = (1.0 - dropout_rate) * jnp.sum(sizes)
+            order = jax.random.permutation(rng, len(names))
+
+            def body(partial, i):
+                size_i = sizes[order[i]]
+                keep = partial + size_i <= threshold
+                return partial + size_i * keep, keep
+
+            _, keep_ord = jax.lax.scan(
+                body, jnp.float32(0.0), jnp.arange(len(names))
+            )
+            keep = jnp.zeros(len(names), bool).at[order].set(keep_ord)
+            sent = {
+                k: delta[k] * keep[i].astype(jnp.float32)
+                for i, k in enumerate(names)
+            }
+            send_num = jnp.sum(keep * sizes)
+            return sent, send_num
+
+        def local_train(global_params, err, data, weight, rng):
+            rng, sparse_rng = jax.random.split(rng)
+            params = global_params
+            opt_state = engine.optimizer.init(params)
+
+            def epoch_body(carry, epoch_rng):
+                params, opt_state = carry
+                params, opt_state, metrics = engine.train_epoch_fn(
+                    params, opt_state, data, epoch_rng
+                )
+                return (params, opt_state), metrics
+
+            (params, _), metrics = jax.lax.scan(
+                epoch_body,
+                (params, opt_state),
+                jax.random.split(rng, epochs),
+            )
+            summed = jax.tree.map(lambda x: jnp.sum(x), metrics)
+
+            selected = (weight > 0).astype(jnp.float32)
+            delta = {
+                k: params[k].astype(jnp.float32)
+                - global_params[k].astype(jnp.float32)
+                + err[k]
+                for k in params
+            }
+            sent, send_num = sparsify(delta, sparse_rng)
+            # residual: what was truncated this round; unselected slots keep
+            # their residual untouched (they skipped the round)
+            new_err = {
+                k: selected * (delta[k] - sent[k]) + (1 - selected) * err[k]
+                for k in delta
+            }
+            upload = {
+                k: global_params[k].astype(jnp.float32) + sent[k] for k in sent
+            }
+            contribution = jax.tree.map(lambda p: p * weight, upload)
+            summed = dict(summed, send_num=send_num * selected)
+            return contribution, new_err, summed
+
+        def round_program(global_params, err_state, weights, rngs, data):
+            def shard_body(global_params, err_state, data, weights, rngs):
+                contributions, new_err, metrics = jax.vmap(
+                    local_train, in_axes=(None, 0, 0, 0, 0)
+                )(global_params, err_state, data, weights, rngs)
+                local_sum = jax.tree.map(
+                    lambda c: jnp.sum(c, axis=0), contributions
+                )
+                global_sum = jax.tree.map(
+                    lambda s: jax.lax.psum(s, axis_name="clients"), local_sum
+                )
+                total_weight = jax.lax.psum(jnp.sum(weights), axis_name="clients")
+                new_global = jax.tree.map(
+                    lambda s, g: (s / jnp.maximum(total_weight, 1e-12)).astype(
+                        g.dtype
+                    ),
+                    global_sum,
+                    global_params,
+                )
+                metrics = jax.tree.map(
+                    lambda m: jax.lax.psum(jnp.sum(m), axis_name="clients"),
+                    metrics,
+                )
+                return new_global, new_err, metrics
+
+            return shard_map_compat(
+                shard_body,
+                self.mesh,
+                in_specs=(P(), P("clients"), P("clients"), P("clients"), P("clients")),
+                out_specs=(P(), P("clients"), P()),
+            )(global_params, err_state, data, weights, rngs)
+
+        jitted = jax.jit(round_program, donate_argnums=(0, 1))
+
+        def fn(global_params, weights, rngs):
+            new_global, self._err_state, metrics = jitted(
+                global_params, self._err_state, weights, rngs, self._data
+            )
+            return new_global, metrics
+
+        return fn

@@ -245,10 +245,19 @@ def _run_task(ctx: TaskContext, return_task_id: bool, task_id: Any) -> dict | An
                 *session_args,
                 share_feature=True if algo == "fed_gcn" else None,
             )
+        elif algo == "fed_dropout_avg":
+            from .parallel.spmd_sparse import SpmdFedDropoutAvgSession
+
+            session = SpmdFedDropoutAvgSession(*session_args)
+        elif algo == "single_model_afd":
+            from .parallel.spmd_sparse import SpmdSMAFDSession
+
+            session = SpmdSMAFDSession(*session_args)
         else:
             raise NotImplementedError(
                 f"no SPMD round program for {algo!r}; supported: fed_avg, "
-                "fed_paq, fed_obd, fed_obd_sq, fed_gnn, fed_gcn, sign_SGD "
+                "fed_paq, fed_obd, fed_obd_sq, fed_gnn, fed_gcn, "
+                "fed_dropout_avg, single_model_afd, sign_SGD "
                 "(use the threaded executor)"
             )
         result = session.run()

@@ -154,3 +154,45 @@ def test_spmd_fed_gcn_learns():
     result = train(_gnn_config(distributed_algorithm="fed_gcn", round=4))
     accs = [result["performance"][r]["test_accuracy"] for r in (1, 4)]
     assert accs[-1] >= accs[0] - 0.05
+
+
+def test_spmd_fed_dropout_avg():
+    """Per-element Bernoulli dropout with per-element weight division."""
+    result = train(
+        _config(
+            distributed_algorithm="fed_dropout_avg",
+            algorithm_kwargs={"dropout_rate": 0.3},
+        )
+    )
+    assert len(result["performance"]) == 2
+    for stat in result["performance"].values():
+        assert np.isfinite(stat["test_loss"])
+
+
+def test_spmd_smafd_topk_and_dropout():
+    """single_model_afd: error-feedback residual carried on device across
+    rounds, both sparsifier variants."""
+    for akw in (
+        {"topk_ratio": 0.2},
+        {"dropout_rate": 0.5},
+    ):
+        result = train(
+            _config(distributed_algorithm="single_model_afd", algorithm_kwargs=akw)
+        )
+        assert len(result["performance"]) == 2
+        for stat in result["performance"].values():
+            assert np.isfinite(stat["test_loss"])
+
+
+def test_spmd_smafd_error_feedback_converges():
+    """With aggressive sparsification the residual must keep information:
+    training still reduces loss over rounds."""
+    result = train(
+        _config(
+            distributed_algorithm="single_model_afd",
+            round=4,
+            algorithm_kwargs={"topk_ratio": 0.1},
+        )
+    )
+    losses = [result["performance"][r]["test_loss"] for r in (1, 4)]
+    assert losses[-1] < losses[0]
