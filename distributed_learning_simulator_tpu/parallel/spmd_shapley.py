@@ -1,0 +1,222 @@
+"""Shapley-value methods as SPMD programs.
+
+One program trains every client slot for the round and returns the STACKED
+per-client parameters (no reduction — the SV engines need individual
+uploads).  Subset metrics then evaluate directly on that device-resident
+stack: a 0/1 worker mask per subset, masked weighted average, and central
+inference — vmapped over subsets, with XLA inserting the cross-slot
+collectives from the shardings.  Per round this replaces the reference's
+"one full test inference per evaluated subset" (SURVEY.md §3.3 HOT) with a
+handful of batched programs, and client params never visit the host.
+
+Engines are the same host-side ``shapley/`` classes the threaded path uses
+(GTG / multi-round / hierarchical); ``choose_best_subset``,
+``need_init_performance`` (round-0 metric), per-round SV dicts, and
+``shapley_values.json`` artifacts match the threaded server
+(``method/shapley_value``)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..engine.batching import make_epoch_batches
+from ..ml_type import MachineLearningPhase as Phase
+from ..utils.logging import get_logger
+from .spmd import SpmdFedAvgSession, shard_map_compat
+
+ENGINE_FOR = {
+    "GTG_shapley_value": "GTGShapleyValue",
+    "multiround_shapley_value": "MultiRoundShapleyValue",
+    "Hierarchical_shapley_value": "HierarchicalShapleyValue",
+}
+
+
+class SpmdShapleySession(SpmdFedAvgSession):
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        from .. import shapley
+
+        engine_name = ENGINE_FOR[self.config.distributed_algorithm]
+        self._engine_cls = getattr(shapley, engine_name)
+        self._sv_engine = None
+        self.shapley_values: dict[int, dict] = {}
+        self.shapley_values_S: dict[int, dict] = {}
+        self._eval_batches = jax.device_put(
+            make_epoch_batches(
+                self.dc.get_dataset(Phase.Test), self.config.batch_size
+            ),
+            self._replicated,
+        )
+        self._subset_eval = self._build_subset_eval()
+
+    # ------------------------------------------------------------------
+    def _build_round_fn(self):
+        engine = self.engine
+        epochs = self.config.epoch
+
+        def local_train(global_params, data, weight, rng):
+            params = global_params
+            opt_state = engine.optimizer.init(params)
+
+            def epoch_body(carry, epoch_rng):
+                params, opt_state = carry
+                params, opt_state, metrics = engine.train_epoch_fn(
+                    params, opt_state, data, epoch_rng
+                )
+                return (params, opt_state), metrics
+
+            (params, _), metrics = jax.lax.scan(
+                epoch_body, (params, opt_state), jax.random.split(rng, epochs)
+            )
+            return (
+                jax.tree.map(lambda p: p.astype(jnp.float32), params),
+                jax.tree.map(lambda x: jnp.sum(x), metrics),
+            )
+
+        def round_program(global_params, weights, rngs, data):
+            def shard_body(global_params, data, weights, rngs):
+                params_s, metrics = jax.vmap(
+                    local_train, in_axes=(None, 0, 0, 0)
+                )(global_params, data, weights, rngs)
+                metrics = jax.tree.map(
+                    lambda m: jax.lax.psum(jnp.sum(m), axis_name="clients"),
+                    metrics,
+                )
+                return params_s, metrics
+
+            return shard_map_compat(
+                shard_body,
+                self.mesh,
+                in_specs=(P(), P("clients"), P("clients"), P("clients")),
+                out_specs=(P("clients"), P()),
+            )(global_params, data, weights, rngs)
+
+        jitted = jax.jit(round_program)
+
+        def fn(global_params, weights, rngs):
+            return jitted(global_params, weights, rngs, self._data)
+
+        return fn
+
+    def _build_subset_eval(self):
+        engine = self.engine
+
+        @jax.jit
+        def subset_eval(params_s, masks, weights, batches):
+            def agg_one(mask):
+                w = mask * weights
+                tw = jnp.maximum(jnp.sum(w), 1e-12)
+                return jax.tree.map(
+                    lambda v: jnp.einsum("s,s...->...", w, v) / tw, params_s
+                )
+
+            params = jax.vmap(agg_one)(masks)
+            return jax.vmap(lambda p: engine.eval_fn(p, batches))(params)
+
+        return subset_eval
+
+    # ------------------------------------------------------------------
+    def _batch_metric(self, params_s, weights):
+        workers = list(range(self.config.worker_number))
+
+        def metric_many(subsets: list) -> list[float]:
+            chunk = 16
+            masks = np.zeros((len(subsets), self.n_slots), np.float32)
+            for i, subset in enumerate(subsets):
+                for w in subset:
+                    masks[i, int(w)] = 1.0
+            out: list[float] = []
+            for start in range(0, len(subsets), chunk):
+                part = masks[start : start + chunk]
+                if part.shape[0] < chunk:
+                    part = np.pad(part, ((0, chunk - part.shape[0]), (0, 0)))
+                    part[len(masks) - start :, 0] = 1.0
+                res = self._subset_eval(
+                    params_s, jnp.asarray(part), weights, self._eval_batches
+                )
+                count = np.maximum(np.asarray(res["count"]), 1.0)
+                acc = np.asarray(res["correct"]) / count
+                out.extend(float(a) for a in acc[: len(masks) - start])
+            return out[: len(subsets)]
+
+        return workers, metric_many
+
+    def run(self) -> dict:
+        config = self.config
+        save_dir = os.path.join(config.save_dir, "server")
+        os.makedirs(save_dir, exist_ok=True)
+        global_params = jax.device_put(
+            self.engine.init_params(config.seed), self._replicated
+        )
+        # need_init_performance: round-0 metric seeds the SV engine
+        # (reference ``shapley_value_server.py:4-7``)
+        init_metric = self._evaluate(global_params)
+        self._stat[0] = {f"test_{k}": v for k, v in init_metric.items()}
+        rng = jax.random.PRNGKey(config.seed)
+        choose_best = bool(config.algorithm_kwargs.get("choose_best_subset", False))
+
+        for round_number in range(1, config.round + 1):
+            weights = jax.device_put(
+                self._select_weights(round_number), self._client_sharding
+            )
+            rng, round_rng = jax.random.split(rng)
+            client_rngs = jax.device_put(
+                jax.random.split(round_rng, self.n_slots), self._client_sharding
+            )
+            params_s, _ = self._round_fn(global_params, weights, client_rngs)
+
+            workers, metric_many = self._batch_metric(params_s, weights)
+            if self._sv_engine is None:
+                self._sv_engine = self._engine_cls(
+                    players=workers,
+                    last_round_metric=self._stat[0]["test_accuracy"],
+                    **dict(config.algorithm_kwargs.get("sv_kwargs", {})),
+                )
+            self._sv_engine.set_metric_function(
+                lambda subset: metric_many([subset])[0]
+            )
+            self._sv_engine.set_batch_metric_function(metric_many)
+            self._sv_engine.compute(round_number=round_number)
+            self.shapley_values[round_number] = dict(
+                self._sv_engine.shapley_values[round_number]
+            )
+            self.shapley_values_S[round_number] = dict(
+                self._sv_engine.shapley_values_S[round_number]
+            )
+
+            agg_mask = np.zeros(self.n_slots, np.float32)
+            if choose_best and self.shapley_values_S[round_number]:
+                for w in self.shapley_values_S[round_number]:
+                    agg_mask[int(w)] = 1.0
+                get_logger().info(
+                    "use subset %s", sorted(self.shapley_values_S[round_number])
+                )
+            else:
+                agg_mask[: config.worker_number] = 1.0
+            global_params = jax.tree.map(
+                lambda v: jnp.einsum(
+                    "s,s...->...",
+                    jnp.asarray(agg_mask * self._dataset_sizes)
+                    / max(float((agg_mask * self._dataset_sizes).sum()), 1e-12),
+                    v,
+                ),
+                params_s,
+            )
+            metric = self._evaluate(global_params)
+            self._record(round_number, metric, global_params, save_dir)
+
+        with open(
+            os.path.join(config.save_dir, "shapley_values.json"),
+            "wt",
+            encoding="utf8",
+        ) as f:
+            json.dump({str(k): v for k, v in self.shapley_values.items()}, f)
+        return {
+            "performance": {k: v for k, v in self._stat.items() if k > 0},
+            "sv": self.shapley_values,
+            "sv_S": self.shapley_values_S,
+        }

@@ -253,6 +253,14 @@ def _run_task(ctx: TaskContext, return_task_id: bool, task_id: Any) -> dict | An
             from .parallel.spmd_sparse import SpmdSMAFDSession
 
             session = SpmdSMAFDSession(*session_args)
+        elif algo in (
+            "GTG_shapley_value",
+            "multiround_shapley_value",
+            "Hierarchical_shapley_value",
+        ):
+            from .parallel.spmd_shapley import SpmdShapleySession
+
+            session = SpmdShapleySession(*session_args)
         else:
             raise NotImplementedError(
                 f"no SPMD round program for {algo!r}; supported: fed_avg, "

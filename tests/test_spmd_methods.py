@@ -196,3 +196,31 @@ def test_spmd_smafd_error_feedback_converges():
     )
     losses = [result["performance"][r]["test_loss"] for r in (1, 4)]
     assert losses[-1] < losses[0]
+
+
+def test_spmd_gtg_shapley():
+    """Whole-round client training returns the stacked per-client params;
+    every SV subset metric evaluates on the device-resident stack."""
+    result = train(
+        _config(
+            distributed_algorithm="GTG_shapley_value",
+            worker_number=4,
+            round=2,
+        )
+    )
+    assert set(result["performance"]) == {1, 2}
+    assert set(result["sv"]) == {1, 2}
+    assert len(result["sv"][1]) == 4
+
+
+def test_spmd_multiround_shapley_best_subset():
+    result = train(
+        _config(
+            distributed_algorithm="multiround_shapley_value",
+            worker_number=3,
+            round=1,
+            algorithm_kwargs={"choose_best_subset": True},
+        )
+    )
+    assert len(result["sv"][1]) == 3
+    assert result["sv_S"][1]  # best subset recorded
