@@ -331,6 +331,7 @@ class SpmdFedGNNSession:
         rng = jax.random.PRNGKey(config.seed)
         test_batch = make_graph_batch(self.dc.get_dataset(Phase.Test))
         for round_number in range(1, config.round + 1):
+            self._before_round(round_number)
             rng, round_rng = jax.random.split(rng)
             client_rngs = jax.device_put(
                 jax.random.split(round_rng, self.n_slots), self._client_sharding
@@ -370,6 +371,53 @@ class SpmdFedGNNSession:
                 )
         return {"performance": self._stat}
 
+    def _before_round(self, round_number: int) -> None:
+        """Hook for per-round data changes (same compiled program — edge
+        masks are program ARGUMENTS, so new masks don't recompile)."""
+
     @property
     def performance_stat(self) -> dict:
         return self._stat
+
+
+class SpmdFedAASSession(SpmdFedGNNSession):
+    """fed_aas: local-subgraph training (no exchange) with a per-round
+    GraphSAGE-style fan-in cap resampled each round (threaded counterpart:
+    ``method/fed_aas/FedAASWorker._before_round``)."""
+
+    def __init__(self, *args, **kwargs) -> None:
+        kwargs.setdefault("share_feature", False)
+        super().__init__(*args, **kwargs)
+        config = self.config
+        self._num_neighbor = config.algorithm_kwargs.get(
+            "num_neighbor", config.extra_hyper_parameters.get("num_neighbor")
+        )
+        self._base_local = np.asarray(self._data["local_edges"]).astype(bool)
+        self._dst = np.asarray(self._data["edge_index"])[1]
+
+    def _before_round(self, round_number: int) -> None:
+        if self._num_neighbor is None:
+            return
+        limit = int(self._num_neighbor)
+        S = self._base_local.shape[0]
+        resampled = np.zeros_like(self._base_local, np.float32)
+        for c in range(S):
+            base = self._base_local[c]
+            rng = np.random.default_rng(
+                self.config.seed * 1013 + c * 97 + round_number
+            )
+            candidates = rng.permutation(np.nonzero(base)[0])
+            if not len(candidates):
+                continue
+            d = self._dst[candidates]
+            by_dst = np.argsort(d, kind="stable")
+            sorted_d = d[by_dst]
+            first_idx = np.r_[0, np.nonzero(np.diff(sorted_d))[0] + 1]
+            group_id = np.cumsum(
+                np.r_[0, (np.diff(sorted_d) != 0).astype(np.int64)]
+            )
+            rank = np.arange(len(sorted_d)) - first_idx[group_id]
+            resampled[c, candidates[by_dst[rank < limit]]] = 1.0
+        masks = jax.device_put(resampled, self._client_sharding)
+        self._data["local_edges"] = masks
+        self._data["cross_edges"] = masks

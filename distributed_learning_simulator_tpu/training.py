@@ -245,6 +245,10 @@ def _run_task(ctx: TaskContext, return_task_id: bool, task_id: Any) -> dict | An
                 *session_args,
                 share_feature=True if algo == "fed_gcn" else None,
             )
+        elif algo == "fed_aas":
+            from .parallel.spmd_gnn import SpmdFedAASSession
+
+            session = SpmdFedAASSession(*session_args)
         elif algo == "fed_dropout_avg":
             from .parallel.spmd_sparse import SpmdFedDropoutAvgSession
 
@@ -263,10 +267,9 @@ def _run_task(ctx: TaskContext, return_task_id: bool, task_id: Any) -> dict | An
             session = SpmdShapleySession(*session_args)
         else:
             raise NotImplementedError(
-                f"no SPMD round program for {algo!r}; supported: fed_avg, "
-                "fed_paq, fed_obd, fed_obd_sq, fed_gnn, fed_gcn, "
-                "fed_dropout_avg, single_model_afd, sign_SGD "
-                "(use the threaded executor)"
+                f"no SPMD round program for {algo!r} (every built-in method "
+                "has one; custom registrations fall back to the threaded "
+                "executor)"
             )
         result = session.run()
         get_logger().info("training took %.2f seconds", ctx.timer.elapsed_seconds())

@@ -224,3 +224,20 @@ def test_spmd_multiround_shapley_best_subset():
     )
     assert len(result["sv"][1]) == 3
     assert result["sv_S"][1]  # best subset recorded
+
+
+def test_spmd_fed_aas():
+    """Per-round fan-in resampling feeds new edge masks as program
+    arguments — no recompile between rounds."""
+    result = train(
+        _gnn_config(
+            distributed_algorithm="fed_aas",
+            model_name="SimpleGCN",
+            round=2,
+            algorithm_kwargs={"num_neighbor": 4},
+        )
+    )
+    assert len(result["performance"]) == 2
+    for stat in result["performance"].values():
+        assert np.isfinite(stat["test_loss"])
+        assert stat["received_mb"] == 0  # no boundary exchange in fed_aas
