@@ -20,6 +20,26 @@ from ...worker.graph_worker import GraphWorker
 from ..algorithm_factory import CentralizedAlgorithmFactory
 
 
+def cap_fan_in(
+    base_mask: np.ndarray, dst: np.ndarray, limit: int, rng
+) -> np.ndarray:
+    """Cap incoming fan-in per destination node at ``limit``: random
+    permutation, stable-sort by destination, keep rank-within-destination
+    < limit (vectorized — edge lists are large).  Shared by the threaded
+    worker and the SPMD session so their RNG streams stay identical."""
+    candidates = rng.permutation(np.nonzero(base_mask)[0])
+    keep = np.zeros_like(base_mask, dtype=bool)
+    if len(candidates):
+        d = dst[candidates]
+        by_dst = np.argsort(d, kind="stable")
+        sorted_d = d[by_dst]
+        first_idx = np.r_[0, np.nonzero(np.diff(sorted_d))[0] + 1]
+        group_id = np.cumsum(np.r_[0, (np.diff(sorted_d) != 0).astype(np.int64)])
+        rank = np.arange(len(sorted_d)) - first_idx[group_id]
+        keep[candidates[by_dst[rank < limit]]] = True
+    return keep
+
+
 class FedAASWorker(GraphWorker):
     def __init__(self, **kwargs) -> None:
         super().__init__(**kwargs)
@@ -41,21 +61,7 @@ class FedAASWorker(GraphWorker):
         rng = np.random.default_rng(
             self.config.seed * 1013 + self.worker_id * 97 + self._round_num
         )
-        # cap incoming fan-in per destination at num_neighbor, resampled
-        # each round: random permutation, stable-sort by destination, keep
-        # rank-within-destination < limit (vectorized — edge lists are large)
-        candidates = rng.permutation(np.nonzero(base)[0])
-        limit = int(self._num_neighbor)
-        keep = np.zeros_like(base)
-        if len(candidates):
-            d = dst[candidates]
-            by_dst = np.argsort(d, kind="stable")
-            sorted_d = d[by_dst]
-            n_sorted = len(sorted_d)
-            first_idx = np.r_[0, np.nonzero(np.diff(sorted_d))[0] + 1]
-            group_id = np.cumsum(np.r_[0, (np.diff(sorted_d) != 0).astype(np.int64)])
-            rank = np.arange(n_sorted) - first_idx[group_id]
-            keep[candidates[by_dst[rank < limit]]] = True
+        keep = cap_fan_in(base, dst, int(self._num_neighbor), rng)
         graph["edge_mask"] = keep.astype(np.float32)
         get_logger().debug(
             "%s round %d: neighbor sampling kept %d/%d local edges",

@@ -398,26 +398,16 @@ class SpmdFedAASSession(SpmdFedGNNSession):
     def _before_round(self, round_number: int) -> None:
         if self._num_neighbor is None:
             return
+        from ..method.fed_aas import cap_fan_in
+
         limit = int(self._num_neighbor)
-        S = self._base_local.shape[0]
         resampled = np.zeros_like(self._base_local, np.float32)
-        for c in range(S):
-            base = self._base_local[c]
+        for c in range(self._base_local.shape[0]):
+            # same stream as the threaded FedAASWorker (slot == worker_id)
             rng = np.random.default_rng(
                 self.config.seed * 1013 + c * 97 + round_number
             )
-            candidates = rng.permutation(np.nonzero(base)[0])
-            if not len(candidates):
-                continue
-            d = self._dst[candidates]
-            by_dst = np.argsort(d, kind="stable")
-            sorted_d = d[by_dst]
-            first_idx = np.r_[0, np.nonzero(np.diff(sorted_d))[0] + 1]
-            group_id = np.cumsum(
-                np.r_[0, (np.diff(sorted_d) != 0).astype(np.int64)]
-            )
-            rank = np.arange(len(sorted_d)) - first_idx[group_id]
-            resampled[c, candidates[by_dst[rank < limit]]] = 1.0
+            resampled[c] = cap_fan_in(self._base_local[c], self._dst, limit, rng)
         masks = jax.device_put(resampled, self._client_sharding)
         self._data["local_edges"] = masks
         self._data["cross_edges"] = masks

@@ -268,8 +268,8 @@ def _run_task(ctx: TaskContext, return_task_id: bool, task_id: Any) -> dict | An
         else:
             raise NotImplementedError(
                 f"no SPMD round program for {algo!r} (every built-in method "
-                "has one; custom registrations fall back to the threaded "
-                "executor)"
+                "has one; for custom registrations drop executor=spmd and "
+                "use the threaded executor)"
             )
         result = session.run()
         get_logger().info("training took %.2f seconds", ctx.timer.elapsed_seconds())
