@@ -21,6 +21,46 @@ def make_mesh(model_parallel: int = 1, devices=None) -> Mesh:
     return Mesh(grid, axis_names=("clients", "model"))
 
 
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join a multi-host pod (DCN between hosts, ICI within).
+
+    Thin wrapper over ``jax.distributed.initialize`` — on TPU pods the three
+    arguments auto-detect from the metadata server, so a bare call is enough
+    on each host; afterwards ``jax.devices()`` is the GLOBAL device list and
+    ``make_mesh`` spans the pod.  This is the framework's analogue of the
+    reference's NCCL/MPI bring-up, except the reference never had one (its
+    backend is single-host pipes — SURVEY.md §5): collectives ride ICI/DCN
+    via the mesh, not a side channel.  Idempotent."""
+    if jax.process_count() > 1:
+        return  # already initialized
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError):
+        # single-process run (no coordinator configured) — nothing to join
+        pass
+
+
+def put_sharded(host_data, sharding):
+    """Place host arrays onto the mesh, multi-host aware: with one process
+    this is ``device_put``; on a pod each process contributes only its
+    addressable shard (``make_array_from_process_local_data`` slices the
+    per-host portion of the global batch)."""
+    if jax.process_count() == 1:
+        return jax.device_put(host_data, sharding)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        host_data,
+    )
+
+
 def client_slots(worker_number: int, mesh: Mesh) -> int:
     """Pad the client count to a multiple of the mesh's client axis so every
     device carries the same number of client slots (zero-weight padding

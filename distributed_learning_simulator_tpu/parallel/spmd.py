@@ -138,7 +138,9 @@ class SpmdFedAvgSession:
         # ---- shardings ----
         self._client_sharding = NamedSharding(self.mesh, P("clients"))
         self._replicated = NamedSharding(self.mesh, P())
-        self._data = jax.device_put(
+        from .mesh import put_sharded
+
+        self._data = put_sharded(
             self._data,
             NamedSharding(self.mesh, P("clients")),
         )
@@ -397,7 +399,9 @@ class SpmdSignSGDSession:
         self._client_sharding = NamedSharding(self.mesh, P("clients"))
         self._replicated = NamedSharding(self.mesh, P())
         # scan wants batch-major: [n_batches, C, B, ...]
-        self._data = jax.device_put(
+        from .mesh import put_sharded
+
+        self._data = put_sharded(
             {k: np.swapaxes(v, 0, 1) for k, v in self._data.items()},
             NamedSharding(self.mesh, P(None, "clients")),
         )

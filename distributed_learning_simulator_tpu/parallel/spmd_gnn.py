@@ -143,12 +143,14 @@ class SpmdFedGNNSession:
         replicated = NamedSharding(self.mesh, P())
         self._client_sharding = client_sharding
         self._replicated = replicated
+        from .mesh import put_sharded
+
         self._data = {
-            "local_edges": jax.device_put(local_edges, client_sharding),
-            "cross_edges": jax.device_put(cross_edges, client_sharding),
-            "provide": jax.device_put(provide_mask, client_sharding),
-            "recv": jax.device_put(recv_mask, client_sharding),
-            "train_mask": jax.device_put(train_mask, client_sharding),
+            "local_edges": put_sharded(local_edges, client_sharding),
+            "cross_edges": put_sharded(cross_edges, client_sharding),
+            "provide": put_sharded(provide_mask, client_sharding),
+            "recv": put_sharded(recv_mask, client_sharding),
+            "train_mask": put_sharded(train_mask, client_sharding),
             "x": jax.device_put(np.asarray(graph["x"], np.float32), replicated),
             "edge_index": jax.device_put(edge_index, replicated),
             "targets": jax.device_put(
