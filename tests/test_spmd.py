@@ -45,3 +45,24 @@ def test_spmd_learns_and_selection(tmp_session_dir):
     )
     best = max(s["test_accuracy"] for s in result["performance"].values())
     assert best > 0.5
+
+
+def test_put_sharded_single_process_matches_device_put():
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_learning_simulator_tpu.parallel.mesh import (
+        initialize_multihost,
+        make_mesh,
+        put_sharded,
+    )
+
+    initialize_multihost()  # no-op without a coordinator
+    mesh = make_mesh()
+    data = {"a": np.arange(mesh.shape["clients"] * 4, dtype=np.float32).reshape(
+        mesh.shape["clients"], 4
+    )}
+    out = put_sharded(data, NamedSharding(mesh, P("clients")))
+    np.testing.assert_array_equal(np.asarray(out["a"]), data["a"])
+    assert out["a"].sharding.spec == P("clients")
