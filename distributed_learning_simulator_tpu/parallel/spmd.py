@@ -306,16 +306,57 @@ class SpmdFedAvgSession:
             weights[worker_id] = self._dataset_sizes[worker_id]
         return weights
 
+    def _init_global_params(self):
+        """Initial params + first round: resume from a previous session's
+        latest ``aggregated_model/round_N.npz`` (mirrors the threaded
+        ``AggregationServer._try_resume``), else ``global_model_path`` warm
+        start, else fresh init."""
+        config = self.config
+        resume_dir = config.algorithm_kwargs.get("resume_dir")
+        if resume_dir:
+            model_dir = os.path.join(resume_dir, "aggregated_model")
+            rounds = (
+                sorted(
+                    int(name.split("_")[1].split(".")[0])
+                    for name in os.listdir(model_dir)
+                    if name.startswith("round_") and name.endswith(".npz")
+                )
+                if os.path.isdir(model_dir)
+                else []
+            )
+            if rounds:
+                last = rounds[-1]
+                blob = np.load(os.path.join(model_dir, f"round_{last}.npz"))
+                record = os.path.join(resume_dir, "server", "round_record.json")
+                if os.path.isfile(record):
+                    with open(record, encoding="utf8") as f:
+                        for key, value in json.load(f).items():
+                            if int(key) <= last:
+                                self._stat[int(key)] = value
+                if self._stat:
+                    self._max_acc = max(
+                        s["test_accuracy"] for s in self._stat.values()
+                    )
+                get_logger().info("resumed from %s round %d", resume_dir, last)
+                params = {k: blob[k] for k in blob.files}
+                return jax.device_put(params, self._replicated), last + 1
+        init_path = config.algorithm_kwargs.get("global_model_path")
+        if init_path:
+            blob = np.load(init_path)
+            params = {k: blob[k] for k in blob.files}
+            return jax.device_put(params, self._replicated), 1
+        return (
+            jax.device_put(self.engine.init_params(config.seed), self._replicated),
+            1,
+        )
+
     def run(self) -> dict:
         config = self.config
-        global_params = jax.device_put(
-            self.engine.init_params(config.seed), self._replicated
-        )
-        eval_batches = None
+        global_params, start_round = self._init_global_params()
         save_dir = os.path.join(config.save_dir, "server")
         os.makedirs(save_dir, exist_ok=True)
         rng = jax.random.PRNGKey(config.seed)
-        for round_number in range(1, config.round + 1):
+        for round_number in range(start_round, config.round + 1):
             weights = jax.device_put(
                 self._select_weights(round_number), self._client_sharding
             )
@@ -351,11 +392,14 @@ class SpmdFedAvgSession:
             os.path.join(save_dir, "round_record.json"), "wt", encoding="utf8"
         ) as f:
             json.dump(self._stat, f)
+        model_dir = os.path.join(self.config.save_dir, "aggregated_model")
+        os.makedirs(model_dir, exist_ok=True)
+        host_params = {k: np.asarray(v) for k, v in global_params.items()}
+        np.savez(os.path.join(model_dir, f"round_{round_number}.npz"), **host_params)
         if metric["accuracy"] > self._max_acc:
             self._max_acc = metric["accuracy"]
             np.savez(
-                os.path.join(save_dir, "best_global_model.npz"),
-                **{k: np.asarray(v) for k, v in global_params.items()},
+                os.path.join(save_dir, "best_global_model.npz"), **host_params
             )
 
     @property

@@ -39,3 +39,25 @@ def test_resume_from_previous_session(tmp_session_dir):
     assert set(result2["performance"]) == {1, 2, 3, 4}
     assert result2["performance"][1] == result1["performance"][1]
     assert result2["performance"][2] == result1["performance"][2]
+
+
+def test_spmd_resume_from_previous_session(tmp_session_dir):
+    """The SPMD fast path writes per-round aggregated_model checkpoints and
+    resumes from them like the threaded server."""
+    first = _config(executor="spmd", worker_number=4)
+    first.load_config_and_process()
+    result1 = train(first)
+    assert set(result1["performance"]) == {1, 2}
+    assert os.path.isdir(os.path.join(first.save_dir, "aggregated_model"))
+
+    resumed = _config(
+        executor="spmd",
+        worker_number=4,
+        round=4,
+        algorithm_kwargs={"resume_dir": first.save_dir},
+    )
+    resumed.load_config_and_process()
+    result2 = train(resumed)
+    assert set(result2["performance"]) == {1, 2, 3, 4}
+    assert result2["performance"][1] == result1["performance"][1]
+    assert result2["performance"][2] == result1["performance"][2]
