@@ -98,6 +98,26 @@ def shard_map_compat(fn, mesh, in_specs, out_specs):
 from ..ops.quantization import qsgd_quantize_dequantize as qsgd_dequantized
 
 
+def scan_local_epochs(engine, epochs: int, global_params, data, rng):
+    """One client's local training: ``epochs`` of minibatch SGD from the
+    fresh global params, optimizer rebuilt (AggregationWorker semantics,
+    ``util/model.py:6-23``).  Returns (params, summed metrics).  Shared by
+    every SPMD session's local-train body."""
+    opt_state = engine.optimizer.init(global_params)
+
+    def epoch_body(carry, epoch_rng):
+        params, opt_state = carry
+        params, opt_state, metrics = engine.train_epoch_fn(
+            params, opt_state, data, epoch_rng
+        )
+        return (params, opt_state), metrics
+
+    (params, _), metrics = jax.lax.scan(
+        epoch_body, (global_params, opt_state), jax.random.split(rng, epochs)
+    )
+    return params, jax.tree.map(lambda x: jnp.sum(x), metrics)
+
+
 class SpmdFedAvgSession:
     """FedAvg-family rounds as single SPMD programs.
 
@@ -154,25 +174,9 @@ class SpmdFedAvgSession:
         quant_level = self.quantization_level
 
         def local_train(global_params, data, weight, rng):
-            """One client slot: E epochs of minibatch SGD from the fresh
-            global params (AggregationWorker semantics: optimizer state is
-            rebuilt each round, ``util/model.py:6-23``)."""
-            params = global_params
-            opt_state = engine.optimizer.init(params)
-
-            def epoch_body(carry, epoch_rng):
-                params, opt_state = carry
-                params, opt_state, metrics = engine.train_epoch_fn(
-                    params, opt_state, data, epoch_rng
-                )
-                return (params, opt_state), metrics
-
+            """One client slot's round contribution."""
             rng, quant_rng = jax.random.split(rng)
-            epoch_rngs = jax.random.split(rng, epochs)
-            (params, opt_state), metrics = jax.lax.scan(
-                epoch_body, (params, opt_state), epoch_rngs
-            )
-            summed = jax.tree.map(lambda x: jnp.sum(x), metrics)
+            params, summed = scan_local_epochs(engine, epochs, global_params, data, rng)
             if quant_level is not None:
                 # fed_paq: the upload delta goes through the stochastic
                 # codec before aggregation sees it

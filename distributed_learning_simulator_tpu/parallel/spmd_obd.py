@@ -40,7 +40,7 @@ import numpy as np
 from ..method.fed_obd.obd_algorithm import get_module_blocks
 from ..ops.quantization import nnadq_quantize_dequantize
 from ..utils.logging import get_logger
-from .spmd import SpmdFedAvgSession, shard_map_compat
+from .spmd import SpmdFedAvgSession, scan_local_epochs, shard_map_compat
 from jax.sharding import PartitionSpec as P
 
 
@@ -129,21 +129,9 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
 
         def local_train(global_params, data, weight, rng):
             rng, quant_rng = jax.random.split(rng)
-            params = global_params
-            opt_state = engine.optimizer.init(params)
-
-            def epoch_body(carry, epoch_rng):
-                params, opt_state = carry
-                params, opt_state, metrics = engine.train_epoch_fn(
-                    params, opt_state, data, epoch_rng
-                )
-                return (params, opt_state), metrics
-
-            epoch_rngs = jax.random.split(rng, epochs)
-            (params, _), metrics = jax.lax.scan(
-                epoch_body, (params, opt_state), epoch_rngs
+            params, summed = scan_local_epochs(
+                engine, epochs, global_params, data, rng
             )
-            summed = jax.tree.map(lambda x: jnp.sum(x), metrics)
 
             selected = (weight > 0).astype(jnp.float32)
             upload = {}

@@ -26,7 +26,7 @@ from jax.sharding import PartitionSpec as P
 from ..engine.batching import make_epoch_batches
 from ..ml_type import MachineLearningPhase as Phase
 from ..utils.logging import get_logger
-from .spmd import SpmdFedAvgSession, shard_map_compat
+from .spmd import SpmdFedAvgSession, scan_local_epochs, shard_map_compat
 
 ENGINE_FOR = {
     "GTG_shapley_value": "GTGShapleyValue",
@@ -59,23 +59,10 @@ class SpmdShapleySession(SpmdFedAvgSession):
         epochs = self.config.epoch
 
         def local_train(global_params, data, weight, rng):
-            params = global_params
-            opt_state = engine.optimizer.init(params)
-
-            def epoch_body(carry, epoch_rng):
-                params, opt_state = carry
-                params, opt_state, metrics = engine.train_epoch_fn(
-                    params, opt_state, data, epoch_rng
-                )
-                return (params, opt_state), metrics
-
-            (params, _), metrics = jax.lax.scan(
-                epoch_body, (params, opt_state), jax.random.split(rng, epochs)
+            params, summed = scan_local_epochs(
+                engine, epochs, global_params, data, rng
             )
-            return (
-                jax.tree.map(lambda p: p.astype(jnp.float32), params),
-                jax.tree.map(lambda x: jnp.sum(x), metrics),
-            )
+            return jax.tree.map(lambda p: p.astype(jnp.float32), params), summed
 
         def round_program(global_params, weights, rngs, data):
             def shard_body(global_params, data, weights, rngs):

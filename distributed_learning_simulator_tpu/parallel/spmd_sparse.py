@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .spmd import SpmdFedAvgSession, shard_map_compat
+from .spmd import SpmdFedAvgSession, scan_local_epochs, shard_map_compat
 
 
 class SpmdFedDropoutAvgSession(SpmdFedAvgSession):
@@ -31,22 +31,9 @@ class SpmdFedDropoutAvgSession(SpmdFedAvgSession):
 
         def local_train(global_params, data, weight, rng):
             rng, drop_rng = jax.random.split(rng)
-            params = global_params
-            opt_state = engine.optimizer.init(params)
-
-            def epoch_body(carry, epoch_rng):
-                params, opt_state = carry
-                params, opt_state, metrics = engine.train_epoch_fn(
-                    params, opt_state, data, epoch_rng
-                )
-                return (params, opt_state), metrics
-
-            (params, _), metrics = jax.lax.scan(
-                epoch_body,
-                (params, opt_state),
-                jax.random.split(rng, epochs),
+            params, summed = scan_local_epochs(
+                engine, epochs, global_params, data, rng
             )
-            summed = jax.tree.map(lambda x: jnp.sum(x), metrics)
 
             num, den = {}, {}
             send_num = jnp.float32(0.0)
@@ -170,22 +157,9 @@ class SpmdSMAFDSession(SpmdFedAvgSession):
 
         def local_train(global_params, err, data, weight, rng):
             rng, sparse_rng = jax.random.split(rng)
-            params = global_params
-            opt_state = engine.optimizer.init(params)
-
-            def epoch_body(carry, epoch_rng):
-                params, opt_state = carry
-                params, opt_state, metrics = engine.train_epoch_fn(
-                    params, opt_state, data, epoch_rng
-                )
-                return (params, opt_state), metrics
-
-            (params, _), metrics = jax.lax.scan(
-                epoch_body,
-                (params, opt_state),
-                jax.random.split(rng, epochs),
+            params, summed = scan_local_epochs(
+                engine, epochs, global_params, data, rng
             )
-            summed = jax.tree.map(lambda x: jnp.sum(x), metrics)
 
             selected = (weight > 0).astype(jnp.float32)
             delta = {
