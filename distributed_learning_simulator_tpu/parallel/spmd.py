@@ -354,16 +354,31 @@ class SpmdFedAvgSession:
             1,
         )
 
+    # wire-cost factor for the stat surface: fraction of full fp32 bytes a
+    # client upload costs (fed_paq's 255-level QSGD packs 8 level bits + 1
+    # sign bit per element)
+    def _upload_cost_factor(self) -> float:
+        if self.quantization_level is not None:
+            import math
+
+            return (math.ceil(math.log2(self.quantization_level + 1)) + 1) / 32
+        return 1.0
+
     def run(self) -> dict:
+        import time as _time
+
         config = self.config
         global_params, start_round = self._init_global_params()
         save_dir = os.path.join(config.save_dir, "server")
         os.makedirs(save_dir, exist_ok=True)
         rng = jax.random.PRNGKey(config.seed)
+        param_mb = sum(
+            int(np.prod(v.shape)) * 4 for v in jax.tree.leaves(global_params)
+        ) / 1e6
         for round_number in range(start_round, config.round + 1):
-            weights = jax.device_put(
-                self._select_weights(round_number), self._client_sharding
-            )
+            start = _time.monotonic()
+            host_weights = self._select_weights(round_number)
+            weights = jax.device_put(host_weights, self._client_sharding)
             rng, round_rng = jax.random.split(rng)
             client_rngs = jax.device_put(
                 jax.random.split(round_rng, self.n_slots), self._client_sharding
@@ -372,7 +387,21 @@ class SpmdFedAvgSession:
                 global_params, weights, client_rngs
             )
             metric = self._evaluate(global_params)
-            self._record(round_number, metric, global_params, save_dir)
+            # same stat surface as the threaded server: analytic wire cost
+            # (what the aggregation consumed over ICI, priced at the
+            # reference's message sizes) + round wall time
+            selected = int((host_weights > 0).sum())
+            self._record(
+                round_number,
+                metric,
+                global_params,
+                save_dir,
+                extra={
+                    "received_mb": selected * param_mb * self._upload_cost_factor(),
+                    "sent_mb": selected * param_mb,
+                    "round_seconds": _time.monotonic() - start,
+                },
+            )
         return {"performance": self._stat}
 
     def _evaluate(self, global_params) -> dict:
@@ -383,8 +412,12 @@ class SpmdFedAvgSession:
         summed = self.engine.evaluate(global_params, batches)
         return summarize_metrics(summed)
 
-    def _record(self, round_number, metric, global_params, save_dir) -> None:
+    def _record(
+        self, round_number, metric, global_params, save_dir, extra=None
+    ) -> None:
         round_stat = {f"test_{k}": v for k, v in metric.items()}
+        if extra:
+            round_stat.update(extra)
         self._stat[round_number] = round_stat
         get_logger().info(
             "round: %d, test accuracy %.4f loss %.4f (spmd)",

@@ -324,10 +324,17 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
 
     # ------------------------------------------------------------------
     def _record_obd(self, stat_key, metric, round_metrics, exact, save_dir):
-        self._record(stat_key, metric, exact, save_dir)
         mb = 1 / 8e6
-        self._stat[stat_key]["received_mb"] = round_metrics["upload_bits"] * mb
-        self._stat[stat_key]["sent_mb"] = round_metrics["bcast_bits"] * mb
+        self._record(
+            stat_key,
+            metric,
+            exact,
+            save_dir,
+            extra={
+                "received_mb": round_metrics["upload_bits"] * mb,
+                "sent_mb": round_metrics["bcast_bits"] * mb,
+            },
+        )
         if round_metrics["upload_bits"]:
             # wire bits / full-precision full-model bits per selected client
             # — the combined dropout × quantization saving (analyze_log

@@ -24,6 +24,9 @@ from .spmd import SpmdFedAvgSession, scan_local_epochs, shard_map_compat
 
 
 class SpmdFedDropoutAvgSession(SpmdFedAvgSession):
+    def _upload_cost_factor(self) -> float:
+        return 1.0 - float(self.config.algorithm_kwargs["dropout_rate"])
+
     def _build_round_fn(self):
         engine = self.engine
         epochs = self.config.epoch
@@ -99,6 +102,12 @@ class SpmdFedDropoutAvgSession(SpmdFedAvgSession):
 class SpmdSMAFDSession(SpmdFedAvgSession):
     """single_model_afd: error-feedback sparsified delta uploads with the
     residual state living on device across rounds."""
+
+    def _upload_cost_factor(self) -> float:
+        kwargs = self.config.algorithm_kwargs
+        if kwargs.get("topk_ratio") is not None:
+            return float(kwargs["topk_ratio"])
+        return 1.0 - float(kwargs.get("dropout_rate", 0.0))
 
     def __init__(self, *args, **kwargs) -> None:
         super().__init__(*args, **kwargs)
