@@ -66,3 +66,32 @@ def test_put_sharded_single_process_matches_device_put():
     out = put_sharded(data, NamedSharding(mesh, P("clients")))
     np.testing.assert_array_equal(np.asarray(out["a"]), data["a"])
     assert out["a"].sharding.spec == P("clients")
+
+
+def test_spmd_matches_threaded_fed_avg_statistically():
+    """Same config through both executors: different rng streams, same
+    algorithm — after two rounds the test metrics must land close."""
+    import numpy as np
+
+    from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+    from distributed_learning_simulator_tpu.training import train
+
+    def run(executor):
+        config = DistributedTrainingConfig(
+            dataset_name="MNIST",
+            model_name="LeNet5",
+            distributed_algorithm="fed_avg",
+            executor=executor,
+            worker_number=4,
+            batch_size=32,
+            round=2,
+            epoch=1,
+            learning_rate=0.05,
+            dataset_kwargs={"train_size": 512, "val_size": 64, "test_size": 128},
+        )
+        return train(config)["performance"][2]
+
+    threaded = run("auto")
+    spmd = run("spmd")
+    assert abs(threaded["test_accuracy"] - spmd["test_accuracy"]) < 0.2
+    assert abs(threaded["test_loss"] - spmd["test_loss"]) < 0.5
