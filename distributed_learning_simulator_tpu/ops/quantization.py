@@ -85,7 +85,11 @@ def nnadq_quantize_dequantize(x: jnp.ndarray, weight: float):
     std = jnp.std(flat)
     # closed-form bit choice (NNADQ._choose_bits), traced: 2^b = 32 ln2 std/w
     b = jnp.log2(jnp.maximum(32.0 * math.log(2.0) * std / weight, 1.0) + 1.0)
-    bits = jnp.clip(jnp.round(b), 2, 8)
+    # ceiling 16, not 8: value-quantizing whole parameter tensors (FedOBD
+    # uploads/broadcasts) needs a step finer than one round's parameter
+    # delta, or deterministic rounding snaps the update away and training
+    # stalls — at weight=1e-3 the closed form asks for ~10 bits
+    bits = jnp.clip(jnp.round(b), 2, 16)
     levels = 2.0**bits - 1.0
     lo = jnp.min(flat)
     span = jnp.maximum(jnp.max(flat) - lo, 1e-12)
@@ -210,7 +214,7 @@ class NNADQ:
     ``E_q(b) + weight * b/32`` where ``E_q(b) ≈ std(x) / 2^b`` is the
     expected rounding error — larger ``weight`` penalizes size harder and
     yields fewer bits.  Solved in closed form (``2^b = 32 ln2 · std /
-    weight``) and clamped to [2, 8].
+    weight``) and clamped to [2, 16].
     """
 
     def __init__(self, weight: float = 0.01) -> None:
@@ -221,7 +225,9 @@ class NNADQ:
         if std <= 0:
             return 2
         b = math.log2(max(32.0 * math.log(2.0) * std / self.weight, 1.0) + 1.0)
-        return int(min(8, max(2, round(b))))
+        # see nnadq_quantize_dequantize: 8-bit ceiling coarser than a round's
+        # parameter delta stalls FedOBD value uploads
+        return int(min(16, max(2, round(b))))
 
     def quant(self, tree: Any) -> dict:
         leaves, treedef = jax.tree.flatten(tree)
