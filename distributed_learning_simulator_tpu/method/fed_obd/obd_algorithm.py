@@ -57,15 +57,11 @@ class OpportunisticBlockDropoutAlgorithm:
             )
 
     def get_block_parameter(self, parameter_dict: Params, model_cache) -> Params:
-        """Return the selected blocks' parameters (full values; the server
-        completes missing keys from the old global model).
-
-        Deviation from the reference: its phase-1 upload stores block *diffs*
-        in ``ParameterMessage.parameter`` which the server then completes
-        with full old values and averages — mixing deltas with parameters
-        (``method/fed_obd/worker.py:59-69``); here the payload is the blocks'
-        parameters, the coherent FedOBD-paper semantics.
-        """
+        """Return the selected blocks' parameters (full values; the caller
+        converts them to diffs vs the cached global for transport — the
+        reference does the same at ``method/fed_obd/worker.py:59-69``, and
+        diff transport is what keeps the NNADQ quantization step far below
+        the parameters' own scale)."""
         if self.__blocks is None:
             self.__find_blocks(parameter_dict)
         assert self.__blocks is not None

@@ -5,7 +5,7 @@ endpoint; on the server's ``phase_two`` signal switches to per-epoch
 
 from typing import Any
 
-from ...message import Message, ParameterMessage
+from ...message import DeltaParameterMessage, Message, ParameterMessage
 from ...ml_type import ExecutorHookPoint
 from ...topology.quantized_endpoint import QuantClientEndpoint
 from ...utils.logging import get_logger
@@ -59,10 +59,24 @@ class FedOBDWorker(AggregationWorker, OpportunisticBlockDropoutAlgorithm):
         data = super()._get_sent_data()
         if self.__phase == Phase.STAGE_ONE:
             assert isinstance(data, ParameterMessage)
-            data.parameter = self.get_block_parameter(
+            kept = self.get_block_parameter(
                 parameter_dict=data.parameter, model_cache=self._model_cache
             )
-            return data
+            # ship the kept blocks as DIFFS vs the cached global (reference
+            # ``worker.py:68`` model_cache.get_parameter_diff): the NNADQ
+            # endpoint then quantizes deltas, whose span is one round's
+            # movement — value quantization would snap that movement back
+            # to the grid and stall training.  The server restores deltas
+            # onto the old global, which also fills dropped blocks
+            # (``message.py`` restore = complete semantics).
+            cached = self._model_cache.parameter_dict
+            return DeltaParameterMessage(
+                delta_parameter={k: v - cached[k] for k, v in kept.items()},
+                dataset_size=data.dataset_size,
+                other_data=data.other_data,
+                in_round=data.in_round,
+                end_training=data.end_training,
+            )
         data.in_round = True
         data.other_data["check_acc"] = True
         return data

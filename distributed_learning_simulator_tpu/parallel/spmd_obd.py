@@ -149,12 +149,19 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 keep = keep_mask(params, global_params)
                 for i, (k, v) in enumerate(params.items()):
                     mask = keep[block_id[k]]
-                    vq, bits = qdq(
-                        v.astype(jnp.float32), jax.random.fold_in(quant_rng, i)
-                    )
                     g = global_params[k].astype(jnp.float32)
+                    # the codec sees the block DIFF, as the reference sends
+                    # (``method/fed_obd/worker.py:68`` get_parameter_diff):
+                    # a delta's span is the span of one round's movement, so
+                    # the quantization step stays far below the values' own
+                    # scale — quantizing VALUES instead snaps the per-round
+                    # drift back to the grid and stalls training
+                    dq, bits = qdq(
+                        v.astype(jnp.float32) - g,
+                        jax.random.fold_in(quant_rng, i),
+                    )
                     # complete(): dropped blocks fall back to the old global
-                    upload[k] = jnp.where(mask, vq, g)
+                    upload[k] = jnp.where(mask, g + dq, g)
                     upload_bits += mask * bits * v.size
             contribution = jax.tree.map(lambda p: p * weight, upload)
             summed = dict(summed, upload_bits=upload_bits * selected)
