@@ -1,5 +1,5 @@
 from .registry import ModelContext, create_model_context, global_model_factory, register_model
-from . import vision, text, graph, long_context, vit, bert  # noqa: F401  (register models)
+from . import vision, text, graph, long_context, vit, bert, moe  # noqa: F401  (register models)
 
 __all__ = [
     "ModelContext",

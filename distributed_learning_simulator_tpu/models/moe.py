@@ -1,0 +1,192 @@
+"""Mixture-of-Experts text classifier with expert parallelism.
+
+Beyond-the-reference model family (the reference's zoo tops out at a dense
+2-layer transformer classifier, ``conf/fed_avg/imdb.yaml``): a
+switch-style top-1-routed MoE feed-forward block whose expert kernels are
+stacked on a leading ``[E, ...]`` axis — the layout that shards over an
+``ep`` mesh axis (``P("ep", ...)``), so expert compute rides the mesh with
+XLA inserting the token ``all_to_all`` at the dispatch/combine einsums.
+
+Routing is the standard Switch-Transformer recipe, applied **per
+sequence**: softmax router, top-1 expert per token, fixed per-expert
+capacity ``C = ceil(cf * L / E)`` within each sequence (static shapes —
+overflow tokens fall through the residual connection).  Per-sequence
+capacity keeps the dispatch tensor at ``[B, L, E, C]`` ≈ ``cf·B·L²``
+elements instead of the ``cf·(B·L)²`` a flat-token dispatch costs.
+Padding tokens are masked out of routing: they reach no expert, consume
+no capacity, and do not enter the load-balancing auxiliary loss
+``E · Σ_e f_e · p_e`` (sowed under ``intermediates/moe_aux_loss``; added
+to the objective by ``ModelContext.loss``).
+"""
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .registry import ModelContext, example_batch, register_model
+from .text import EncoderLayer, masked_mean_pool, sinusoidal_positions
+
+
+class MoEFeedForward(nn.Module):
+    d_model: int
+    d_ff: int
+    n_experts: int
+    capacity_factor: float = 1.25
+    ep_axis: str | None = None  # mesh axis name to constrain expert dim to
+
+    @nn.compact
+    def __call__(self, x, pad_mask=None):
+        batch, seq_len, d_model = x.shape
+        if pad_mask is None:
+            mask = jnp.ones((batch, seq_len), jnp.float32)
+        else:
+            mask = pad_mask.astype(jnp.float32)
+        capacity = max(
+            1, math.ceil(self.capacity_factor * seq_len / self.n_experts)
+        )
+
+        router_logits = nn.Dense(self.n_experts, use_bias=False, name="router")(x)
+        probs = jax.nn.softmax(router_logits.astype(jnp.float32))  # [B, L, E]
+        expert_index = jnp.argmax(probs, axis=-1)  # [B, L]
+        gate = jnp.max(probs, axis=-1) * mask  # [B, L]
+
+        # pads route nowhere: no expert slot, no capacity consumed
+        expert_onehot = jax.nn.one_hot(expert_index, self.n_experts) * mask[..., None]
+        # position of each token in its expert's queue within its sequence;
+        # tokens beyond capacity are dropped (residual carries them)
+        position = jnp.cumsum(expert_onehot, axis=1) * expert_onehot - 1.0
+        within_capacity = (position < capacity) & (position >= 0)
+        dispatch = (
+            (expert_onehot * within_capacity)[..., None]
+            * jax.nn.one_hot(
+                jnp.clip(position, 0, capacity - 1).astype(jnp.int32), capacity
+            )
+        )  # [B, L, E, C]
+
+        # load-balancing aux loss over real tokens only
+        n_tokens = jnp.maximum(mask.sum(), 1.0)
+        fraction = expert_onehot.sum(axis=(0, 1)) / n_tokens
+        prob_mass = (probs * mask[..., None]).sum(axis=(0, 1)) / n_tokens
+        self.sow(
+            "intermediates",
+            "moe_aux_loss",
+            self.n_experts * jnp.sum(fraction * prob_mass),
+        )
+
+        expert_inputs = jnp.einsum("bld,blec->becd", x, dispatch)
+        if self.ep_axis is not None:
+            expert_inputs = jax.lax.with_sharding_constraint(
+                expert_inputs, P(None, self.ep_axis, None, None)
+            )
+        w_in = self.param(
+            "w_in",
+            nn.initializers.lecun_normal(),
+            (self.n_experts, d_model, self.d_ff),
+        )
+        w_out = self.param(
+            "w_out",
+            nn.initializers.lecun_normal(),
+            (self.n_experts, self.d_ff, d_model),
+        )
+        hidden = nn.gelu(jnp.einsum("becd,edf->becf", expert_inputs, w_in))
+        expert_outputs = jnp.einsum("becf,efd->becd", hidden, w_out)
+        if self.ep_axis is not None:
+            expert_outputs = jax.lax.with_sharding_constraint(
+                expert_outputs, P(None, self.ep_axis, None, None)
+            )
+        return jnp.einsum(
+            "becd,blec->bld", expert_outputs, dispatch * gate[..., None, None]
+        )
+
+
+def is_expert_param(name: str, leaf, n_experts: int) -> bool:
+    """True for the expert-stacked kernels (``w_in``/``w_out``) — the ONE
+    place that knows which MoE params carry the leading ``[E]`` axis, so
+    callers shard by declaration instead of re-deriving shape heuristics."""
+    short = name.rsplit("/", 1)[-1]
+    return (
+        short in ("w_in", "w_out")
+        and getattr(leaf, "ndim", 0) == 3
+        and leaf.shape[0] == n_experts
+    )
+
+
+def expert_partition_spec(name: str, leaf, n_experts: int, ep_axis: str = "ep"):
+    """PartitionSpec for one MoE model param: expert kernels shard their
+    leading expert axis over ``ep_axis``, everything else replicates."""
+    if is_expert_param(name, leaf, n_experts):
+        return P(ep_axis, None, None)
+    return P()
+
+
+class MoETransformerClassifier(nn.Module):
+    vocab_size: int
+    num_classes: int
+    d_model: int = 128
+    nhead: int = 4
+    num_encoder_layer: int = 2
+    n_experts: int = 4
+    capacity_factor: float = 1.25
+    max_len: int = 300
+    pad_id: int = 0
+    ep_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        pad_mask = tokens != self.pad_id
+        x = nn.Embed(self.vocab_size, self.d_model)(tokens)
+        x = x + sinusoidal_positions(self.max_len, self.d_model)[None, : tokens.shape[1]]
+        for layer_idx in range(self.num_encoder_layer):
+            ffn = None
+            if layer_idx % 2 == 1:  # alternate dense / MoE like Switch
+                ffn = MoEFeedForward(
+                    d_model=self.d_model,
+                    d_ff=4 * self.d_model,
+                    n_experts=self.n_experts,
+                    capacity_factor=self.capacity_factor,
+                    ep_axis=self.ep_axis,
+                )
+            x = EncoderLayer(
+                self.d_model, self.nhead, 4 * self.d_model, ffn=ffn
+            )(x, pad_mask, train=train)
+        pooled = masked_mean_pool(x, pad_mask)
+        return nn.Dense(self.num_classes)(pooled)
+
+
+@register_model("MoETransformerClassificationModel", "moetransformer")
+def _moe_transformer(
+    dataset_collection,
+    d_model: int = 128,
+    nhead: int = 4,
+    num_encoder_layer: int = 2,
+    n_experts: int = 4,
+    capacity_factor: float = 1.25,
+    max_len: int = 0,
+    ep_axis: str | None = None,
+    aux_loss_weight: float = 0.01,
+    **kwargs,
+) -> ModelContext:
+    meta = dataset_collection.metadata
+    module = MoETransformerClassifier(
+        vocab_size=meta.get("vocab_size", 20000),
+        num_classes=dataset_collection.num_classes,
+        d_model=d_model,
+        nhead=nhead,
+        num_encoder_layer=num_encoder_layer,
+        n_experts=n_experts,
+        capacity_factor=capacity_factor,
+        max_len=max_len or meta.get("max_len", 300),
+        pad_id=meta.get("pad_id", 0),
+        ep_axis=ep_axis,
+    )
+    return ModelContext(
+        name="MoETransformerClassificationModel",
+        module=module,
+        example_input=example_batch(dataset_collection),
+        num_classes=dataset_collection.num_classes,
+        dataset_type="text",
+        aux_loss_weight=aux_loss_weight,
+    )

@@ -42,15 +42,20 @@ class ModelContext:
     dataset_type: str = "vision"
     loss_type: str = "softmax_ce"
     compute_dtype: Any = jnp.float32
+    aux_loss_weight: float = 0.01  # Switch-style router balance weight
 
     def init(self, rng: jax.Array) -> Params:
         example = jax.tree.map(jnp.asarray, self.example_input)
         variables = self.module.init(rng, example, train=False)
         return flatten_nested(variables["params"])
 
-    def apply(self, params: Params, inputs, train: bool = False, rngs=None):
+    def apply(
+        self, params: Params, inputs, train: bool = False, rngs=None, mutable=False
+    ):
         variables = {"params": unflatten_nested(params)}
-        return self.module.apply(variables, inputs, train=train, rngs=rngs)
+        return self.module.apply(
+            variables, inputs, train=train, rngs=rngs, mutable=mutable
+        )
 
     def _cast_for_compute(self, tree):
         if self.compute_dtype == jnp.float32:
@@ -70,14 +75,38 @@ class ModelContext:
         forward/backward runs in bf16 — master params stay float32 and the
         cast is differentiated through, so gradients come back float32 (the
         mixed-precision recipe the MXU wants).
+
+        Auxiliary losses a module sows under ``intermediates`` with a key
+        ending in ``aux_loss`` (the MoE router's load-balancing term) are
+        added to the objective, weighted by :attr:`aux_loss_weight` — the
+        sow is otherwise inert because plain ``apply`` discards it.
         """
-        logits = self.apply(
+        logits, state = self.apply(
             self._cast_for_compute(params),
             self._cast_for_compute(batch["input"]),
             train=train,
             rngs=rngs,
+            mutable=["intermediates"],
         )
-        return masked_ce_loss(logits, batch["target"], batch["mask"])
+        loss, aux = masked_ce_loss(logits, batch["target"], batch["mask"])
+        aux_terms = [
+            jnp.sum(jnp.asarray(leaf).astype(jnp.float32))
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                state.get("intermediates", {})
+            )[0]
+            # sow wraps values in a tuple, so the dict key is not the last
+            # path entry — match any component *ending* in aux_loss
+            if any(str(getattr(p, "key", "")).endswith("aux_loss") for p in path)
+        ]
+        if aux_terms:
+            aux_total = self.aux_loss_weight * sum(aux_terms)
+            loss = loss + aux_total
+            # keep per-sample sums on the same objective, so train-step and
+            # eval losses (which summarize loss_sum) stay comparable
+            aux["loss_sum"] = aux["loss_sum"] + aux_total * batch["mask"].astype(
+                jnp.float32
+            )
+        return loss, aux
 
 
 def masked_ce_loss(logits, targets, mask):

@@ -35,7 +35,10 @@ class EncoderLayer(nn.Module):
     Shared between the reference-parity IMDB classifier (relu, dropout after
     the FFN activation) and the BERT family (gelu, dropout on the attention
     output and after the second FFN dense) — the two placements are toggled
-    rather than duplicated.
+    rather than duplicated.  An ``ffn`` submodule replaces the dense FFN
+    entirely (called as ``ffn(x, pad_mask)``, dropout then applied on its
+    output) — how the MoE family reuses this layer instead of re-wiring
+    attention/LN/residual.
     """
 
     d_model: int
@@ -45,6 +48,7 @@ class EncoderLayer(nn.Module):
     activation: str = "relu"  # "relu" | "gelu"
     attn_out_dropout: bool = False
     ffn_dropout_on_output: bool = False
+    ffn: nn.Module | None = None
 
     @nn.compact
     def __call__(self, x, pad_mask, train: bool = False):
@@ -58,13 +62,17 @@ class EncoderLayer(nn.Module):
         if self.attn_out_dropout:
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = nn.LayerNorm()(x + y)
-        y = nn.Dense(self.dim_feedforward)(x)
-        y = nn.gelu(y) if self.activation == "gelu" else nn.relu(y)
-        if not self.ffn_dropout_on_output:
+        if self.ffn is not None:
+            y = self.ffn(x, pad_mask)
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
-        y = nn.Dense(self.d_model)(y)
-        if self.ffn_dropout_on_output:
-            y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        else:
+            y = nn.Dense(self.dim_feedforward)(x)
+            y = nn.gelu(y) if self.activation == "gelu" else nn.relu(y)
+            if not self.ffn_dropout_on_output:
+                y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+            y = nn.Dense(self.d_model)(y)
+            if self.ffn_dropout_on_output:
+                y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         return nn.LayerNorm()(x + y)
 
 

@@ -1,0 +1,166 @@
+"""GPipe-style pipeline parallelism over a ``pp`` mesh axis.
+
+The reference never shards a single model's computation (SURVEY.md §5
+"long-context / sequence parallelism: absent") — its only axis is the
+client axis.  This module adds the missing model-sharding mode natively:
+a homogeneous stack of S identical stages (transformer encoder trunk,
+DenseNet block sequence, ...) laid out one-stage-per-device over a ``pp``
+mesh axis, fed with M microbatches in the classic GPipe bubble schedule.
+
+The whole schedule is ONE ``lax.scan`` of ``M + S - 1`` ticks inside
+``shard_map``; the stage-to-stage handoff is a ``lax.ppermute`` shift over
+ICI.  Because every collective and select is differentiable, ``jax.grad``
+through :func:`pipeline_apply` yields the reverse (backward) pipeline
+schedule automatically — no hand-written backward pass.
+
+Design rules that keep XLA happy:
+
+* stages must be *homogeneous*: one ``stage_fn`` with stacked parameters
+  ``[S, ...]`` sharded ``P("pp", ...)`` — the SPMD program is identical on
+  every device, stage identity comes from ``axis_index``;
+* the scanned carry (a pytree of ``[mb, ...]`` arrays) must have the same
+  shape at stage input and output (true for encoder trunks);
+* microbatch selection and the last-stage output write are masked
+  ``where``/``dynamic_update_slice`` ops — static shapes, no host control
+  flow.
+"""
+
+from collections.abc import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_body(
+    stage_fn: Callable,
+    stage_params,
+    microbatches,
+    *,
+    axis_name: str,
+    n_stages: int,
+):
+    """The shard_map body: run ``microbatches`` (pytree of ``[M, mb, ...]``)
+    through the S-stage pipeline.  ``stage_params`` is this device's slice
+    ``[1, ...]`` of the stacked stage parameters; ``stage_fn(params, tree)``
+    maps a carry pytree to a carry pytree of identical structure/shape.
+
+    Returns the last stage's outputs ``[M, mb, ...]``, already ``psum``-ed
+    over the pipeline axis so the result is replicated (only the last stage
+    contributes non-zeros).
+    """
+    s_idx = jax.lax.axis_index(axis_name)
+    params_here = jax.tree.map(lambda p: p[0], stage_params)
+    n_micro = jax.tree.leaves(microbatches)[0].shape[0]
+    n_ticks = n_micro + n_stages - 1
+
+    zero_carry = jax.tree.map(lambda x: jnp.zeros_like(x[0]), microbatches)
+    outputs0 = jax.tree.map(jnp.zeros_like, microbatches)
+
+    def tick(carry, t):
+        buf, outputs = carry
+        # stage 0 ingests microbatch t (zeros once the feed is exhausted);
+        # later stages ingest what the previous stage permuted to them
+        feed = jax.tree.map(
+            lambda mb: jnp.where(
+                t < n_micro, jax.lax.dynamic_index_in_dim(
+                    mb, jnp.minimum(t, n_micro - 1), keepdims=False
+                ), jnp.zeros_like(mb[0])
+            ),
+            microbatches,
+        )
+        x_in = jax.tree.map(
+            lambda f, b: jnp.where(s_idx == 0, f, b), feed, buf
+        )
+        y = stage_fn(params_here, x_in)
+        # microbatch (t - S + 1) leaves the pipe at the last stage this tick
+        out_idx = t - (n_stages - 1)
+        write = (s_idx == n_stages - 1) & (out_idx >= 0)
+        safe_idx = jnp.clip(out_idx, 0, n_micro - 1)
+        outputs = jax.tree.map(
+            lambda o, v: jax.lax.dynamic_update_index_in_dim(
+                o,
+                jnp.where(
+                    write, v, jax.lax.dynamic_index_in_dim(o, safe_idx, keepdims=False)
+                ),
+                safe_idx,
+                0,
+            ),
+            outputs,
+            y,
+        )
+        # shift every stage's output one stage forward; stage 0 receives
+        # zeros (no (S-1, 0) edge in the permutation)
+        buf = jax.tree.map(
+            lambda v: jax.lax.ppermute(
+                v, axis_name, [(i, i + 1) for i in range(n_stages - 1)]
+            ),
+            y,
+        )
+        return (buf, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(
+        tick, (zero_carry, outputs0), jnp.arange(n_ticks)
+    )
+    # only the last stage wrote real values; replicate them
+    return jax.tree.map(
+        lambda o: jax.lax.psum(
+            jnp.where(s_idx == n_stages - 1, o, jnp.zeros_like(o)), axis_name
+        ),
+        outputs,
+    )
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    microbatches,
+    mesh: Mesh,
+    *,
+    axis_name: str = "pp",
+):
+    """Run a homogeneous pipeline over ``mesh``'s ``axis_name`` axis.
+
+    ``stage_params``: pytree stacked on a leading ``[S]`` axis (sharded or
+    not — in_specs shard it here).  ``microbatches``: pytree of
+    ``[M, mb, ...]`` arrays, replicated.  Returns the pipeline output
+    ``[M, mb, ...]``, replicated.  Differentiable; ``jax.grad`` yields the
+    backward pipeline schedule (reverse ppermute shifts) for free.
+    """
+    from .spmd import shard_map_compat
+
+    n_stages = mesh.shape[axis_name]
+
+    def body(stage_params, microbatches):
+        return pipeline_body(
+            stage_fn,
+            stage_params,
+            microbatches,
+            axis_name=axis_name,
+            n_stages=n_stages,
+        )
+
+    return shard_map_compat(
+        body,
+        mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+    )(stage_params, microbatches)
+
+
+def stack_stage_params(init_one: Callable[[jax.Array], dict], rng, n_stages: int):
+    """Initialize S independent stages and stack their parameter pytrees on
+    a leading axis (the layout :func:`pipeline_apply` expects)."""
+    rngs = jax.random.split(rng, n_stages)
+    params = [init_one(r) for r in rngs]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params)
+
+
+def split_microbatches(tree, n_micro: int):
+    """Reshape a pytree of ``[B, ...]`` arrays to ``[M, B//M, ...]``."""
+    def split(x):
+        batch = x.shape[0]
+        assert batch % n_micro == 0, (batch, n_micro)
+        return x.reshape(n_micro, batch // n_micro, *x.shape[1:])
+
+    return jax.tree.map(split, tree)

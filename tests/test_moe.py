@@ -1,0 +1,203 @@
+"""MoE expert-parallel model family.
+
+Verifies the Switch-style routing math (top-1 dispatch within capacity,
+gate-weighted combine, dropped tokens fall through the residual), that the
+expert-parallel sharding (``ep`` mesh axis on the stacked expert kernels)
+computes the same function as the unsharded module, and that the family
+trains end-to-end through the standard engine.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_learning_simulator_tpu.models.moe import (
+    MoEFeedForward,
+    MoETransformerClassifier,
+)
+
+
+def test_routing_dispatch_math():
+    """With huge capacity every token reaches its argmax expert and the
+    output equals gate · expert(token)."""
+    d_model, d_ff, n_experts = 8, 16, 4
+    module = MoEFeedForward(
+        d_model=d_model, d_ff=d_ff, n_experts=n_experts, capacity_factor=10.0
+    )
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 6, d_model), jnp.float32)
+    variables = module.init(jax.random.PRNGKey(0), x)
+    out, state = module.apply(x=x, variables=variables, mutable=["intermediates"])
+    params = variables["params"]
+
+    tokens = x.reshape(-1, d_model)
+    logits = tokens @ params["router"]["kernel"]
+    probs = jax.nn.softmax(logits)
+    expert_idx = np.asarray(jnp.argmax(probs, axis=-1))
+    gate = np.asarray(jnp.max(probs, axis=-1))
+    expected = []
+    for t in range(tokens.shape[0]):
+        e = expert_idx[t]
+        hidden = jax.nn.gelu(tokens[t] @ params["w_in"][e])
+        expected.append(gate[t] * (hidden @ params["w_out"][e]))
+    expected = jnp.stack(expected).reshape(x.shape)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5
+    )
+    aux = state["intermediates"]["moe_aux_loss"][0]
+    assert float(aux) >= 1.0 - 1e-5  # E·Σ f_e p_e is minimized at 1 (uniform)
+
+
+def test_capacity_drops_tokens():
+    """capacity 1 with all tokens routed to one expert: only the first
+    token per expert queue produces output, the rest emit zeros."""
+    d_model, n_experts = 4, 2
+    module = MoEFeedForward(
+        d_model=d_model, d_ff=8, n_experts=n_experts, capacity_factor=0.0
+    )  # capacity = max(1, 0) = 1
+    x = jnp.asarray(np.random.RandomState(1).randn(1, 6, d_model), jnp.float32)
+    variables = module.init(jax.random.PRNGKey(1), x)
+    out = module.apply(x=x, variables=variables)
+    tokens = x.reshape(-1, d_model)
+    logits = tokens @ variables["params"]["router"]["kernel"]
+    expert_idx = np.asarray(jnp.argmax(logits, axis=-1))
+    seen = set()
+    out_flat = np.asarray(out).reshape(-1, d_model)
+    for t, e in enumerate(expert_idx):
+        if e in seen:
+            np.testing.assert_allclose(out_flat[t], 0.0, atol=1e-6)
+        seen.add(e)
+
+
+def test_padding_tokens_bypass_experts():
+    """Pad positions reach no expert, consume no capacity, and add nothing
+    to the aux loss — real tokens see the same routing as in a pad-free
+    shorter sequence."""
+    d_model, n_experts = 8, 2
+    module = MoEFeedForward(
+        d_model=d_model, d_ff=16, n_experts=n_experts, capacity_factor=1.0
+    )
+    rng = np.random.RandomState(3)
+    x_real = jnp.asarray(rng.randn(1, 4, d_model), jnp.float32)
+    variables = module.init(jax.random.PRNGKey(3), x_real)
+    # same content + trailing pads, same per-sequence capacity
+    x_padded = jnp.concatenate([x_real, jnp.zeros((1, 4, d_model))], axis=1)
+    pad_mask = jnp.asarray([[1, 1, 1, 1, 0, 0, 0, 0]], bool)
+    out_padded = module.apply(x=x_padded, pad_mask=pad_mask, variables=variables)
+    np.testing.assert_allclose(np.asarray(out_padded[:, 4:]), 0.0, atol=1e-6)
+    # capacity differs (L=8 vs L=4), so compare against an all-real mask of
+    # the same padded length: real-token routing must be unaffected by pads
+    out_all_real = module.apply(
+        x=x_padded, pad_mask=jnp.ones((1, 8), bool), variables=variables
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_padded[:, :4]),
+        np.asarray(out_all_real[:, :4]),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+def test_expert_parallel_matches_unsharded():
+    mesh = Mesh(np.asarray(jax.devices()[:4]), axis_names=("ep",))
+    n_experts = 4
+    dense = MoETransformerClassifier(
+        vocab_size=64, num_classes=3, d_model=16, nhead=2,
+        num_encoder_layer=2, n_experts=n_experts, max_len=12,
+    )
+    tokens = jnp.asarray(
+        np.random.RandomState(2).randint(1, 64, size=(4, 12)), jnp.int32
+    )
+    variables = dense.init(jax.random.PRNGKey(2), tokens)
+    ref = dense.apply(variables, tokens)
+
+    ep = MoETransformerClassifier(
+        vocab_size=64, num_classes=3, d_model=16, nhead=2,
+        num_encoder_layer=2, n_experts=n_experts, max_len=12, ep_axis="ep",
+    )
+
+    from distributed_learning_simulator_tpu.models.moe import expert_partition_spec
+
+    def shard_leaf(path, leaf):
+        name = "/".join(str(p.key) for p in path if hasattr(p, "key"))
+        return jax.device_put(
+            leaf, NamedSharding(mesh, expert_partition_spec(name, leaf, n_experts))
+        )
+
+    sharded_vars = jax.tree_util.tree_map_with_path(shard_leaf, variables)
+    with jax.sharding.set_mesh(mesh):
+        out = jax.jit(lambda v, t: ep.apply(v, t))(sharded_vars, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_aux_loss_reaches_objective():
+    """The sowed router balance term must flow into ModelContext.loss —
+    the router gets gradient pressure even though CE is router-free when
+    all its tokens are dropped."""
+    from distributed_learning_simulator_tpu.data import create_dataset_collection
+    from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+    from distributed_learning_simulator_tpu.models import create_model_context
+
+    config = DistributedTrainingConfig(
+        dataset_name="imdb",
+        model_name="MoETransformerClassificationModel",
+        dataset_kwargs={
+            "max_len": 12, "vocab_size": 64,
+            "train_size": 16, "val_size": 4, "test_size": 4,
+        },
+    )
+    dc = create_dataset_collection(config)
+    ctx = create_model_context(
+        "MoETransformerClassificationModel", dc,
+        d_model=16, nhead=2, num_encoder_layer=2, n_experts=2, max_len=12,
+    )
+    params = ctx.init(jax.random.PRNGKey(0))
+    from distributed_learning_simulator_tpu.ml_type import MachineLearningPhase as Phase
+
+    train = dc.get_dataset(Phase.Training)
+    batch = {
+        "input": jnp.asarray(train.inputs[:8]),
+        "target": jnp.asarray(train.targets[:8]),
+        "mask": jnp.ones(8, jnp.float32),
+    }
+    loss_default = ctx.loss(params, batch)[0]
+    ctx.aux_loss_weight = 0.0
+    loss_no_aux = ctx.loss(params, batch)[0]
+    ctx.aux_loss_weight = 0.01
+    assert float(loss_default) > float(loss_no_aux)  # aux term is positive
+    grads = jax.grad(lambda p: ctx.loss(p, batch)[0])(params)
+    router_grads = [g for k, g in grads.items() if "router" in k]
+    assert router_grads and any(
+        float(jnp.abs(g).max()) > 0 for g in router_grads
+    ), "router got no gradient"
+
+
+def test_trains_through_engine(tmp_session_dir):
+    """The registered model family runs a 1-round fed_avg like any other."""
+    from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+    from distributed_learning_simulator_tpu.training import train
+
+    config = DistributedTrainingConfig(
+        dataset_name="imdb",
+        model_name="MoETransformerClassificationModel",
+        distributed_algorithm="fed_avg",
+        worker_number=2,
+        batch_size=8,
+        round=1,
+        epoch=1,
+        learning_rate=0.05,
+        dataset_kwargs={
+            "max_len": 16, "vocab_size": 128,
+            "train_size": 32, "val_size": 8, "test_size": 16,
+        },
+        model_kwargs={
+            "d_model": 16, "nhead": 2, "num_encoder_layer": 2,
+            "n_experts": 2, "max_len": 16,
+        },
+        save_dir=str(tmp_session_dir / "moe"),
+        log_file=str(tmp_session_dir / "moe.log"),
+    )
+    result = train(config)
+    assert result["performance"], "no round stats"
