@@ -150,6 +150,10 @@ class SpmdFedAvgSession:
         )
         self._stat: dict[int, dict] = {}
         self._max_acc = 0.0
+        from ..util.checkpoint import AsyncCheckpointWriter
+
+        self._ckpt = AsyncCheckpointWriter()
+        self._ckpt_queued_round: int | None = None
 
         self._data, self._dataset_sizes, self.n_batches = stack_client_data(
             config, dataset_collection, practitioners, self.n_slots
@@ -328,19 +332,26 @@ class SpmdFedAvgSession:
                 if os.path.isdir(model_dir)
                 else []
             )
+            record = os.path.join(resume_dir, "server", "round_record.json")
+            recorded: dict[int, dict] = {}
+            if os.path.isfile(record):
+                with open(record, encoding="utf8") as f:
+                    recorded = {int(k): v for k, v in json.load(f).items()}
+            # the round checkpoint is written asynchronously BEFORE the
+            # round's record entry — a crash mid-evaluation leaves a
+            # trailing round_N.npz with no stats row.  Resume only from
+            # rounds that have both, so stats/best-model bookkeeping stay
+            # complete (the orphan npz is simply re-trained).
+            rounds = [n for n in rounds if n in recorded]
             if rounds:
                 last = rounds[-1]
                 blob = np.load(os.path.join(model_dir, f"round_{last}.npz"))
-                record = os.path.join(resume_dir, "server", "round_record.json")
-                if os.path.isfile(record):
-                    with open(record, encoding="utf8") as f:
-                        for key, value in json.load(f).items():
-                            if int(key) <= last:
-                                self._stat[int(key)] = value
-                if self._stat:
-                    self._max_acc = max(
-                        s["test_accuracy"] for s in self._stat.values()
-                    )
+                for key, value in recorded.items():
+                    if key <= last:
+                        self._stat[key] = value
+                self._max_acc = max(
+                    s["test_accuracy"] for s in self._stat.values()
+                )
                 get_logger().info("resumed from %s round %d", resume_dir, last)
                 params = {k: blob[k] for k in blob.files}
                 return jax.device_put(params, self._replicated), last + 1
@@ -375,33 +386,48 @@ class SpmdFedAvgSession:
         param_mb = sum(
             int(np.prod(v.shape)) * 4 for v in jax.tree.leaves(global_params)
         ) / 1e6
-        for round_number in range(start_round, config.round + 1):
-            start = _time.monotonic()
-            host_weights = self._select_weights(round_number)
-            weights = jax.device_put(host_weights, self._client_sharding)
-            rng, round_rng = jax.random.split(rng)
-            client_rngs = jax.device_put(
-                jax.random.split(round_rng, self.n_slots), self._client_sharding
-            )
-            global_params, train_metrics = self._round_fn(
-                global_params, weights, client_rngs
-            )
-            metric = self._evaluate(global_params)
-            # same stat surface as the threaded server: analytic wire cost
-            # (what the aggregation consumed over ICI, priced at the
-            # reference's message sizes) + round wall time
-            selected = int((host_weights > 0).sum())
-            self._record(
-                round_number,
-                metric,
-                global_params,
-                save_dir,
-                extra={
-                    "received_mb": selected * param_mb * self._upload_cost_factor(),
-                    "sent_mb": selected * param_mb,
-                    "round_seconds": _time.monotonic() - start,
-                },
-            )
+        model_dir = os.path.join(config.save_dir, "aggregated_model")
+        os.makedirs(model_dir, exist_ok=True)
+        with self._ckpt:  # flush pending writes at exit, surface errors
+            for round_number in range(start_round, config.round + 1):
+                start = _time.monotonic()
+                host_weights = self._select_weights(round_number)
+                weights = jax.device_put(host_weights, self._client_sharding)
+                rng, round_rng = jax.random.split(rng)
+                client_rngs = jax.device_put(
+                    jax.random.split(round_rng, self.n_slots), self._client_sharding
+                )
+                # old global_params are donated into the round program —
+                # any pending background fetch of them must finish first
+                self._ckpt.wait()
+                global_params, train_metrics = self._round_fn(
+                    global_params, weights, client_rngs
+                )
+                # queue the round checkpoint NOW so its device→host fetch
+                # and disk write overlap the test-set evaluation below
+                self._ckpt.save_npz(
+                    os.path.join(model_dir, f"round_{round_number}.npz"),
+                    global_params,
+                )
+                self._ckpt_queued_round = round_number
+                metric = self._evaluate(global_params)
+                # same stat surface as the threaded server: analytic wire
+                # cost (what the aggregation consumed over ICI, priced at
+                # the reference's message sizes) + round wall time
+                selected = int((host_weights > 0).sum())
+                self._record(
+                    round_number,
+                    metric,
+                    global_params,
+                    save_dir,
+                    extra={
+                        "received_mb": selected
+                        * param_mb
+                        * self._upload_cost_factor(),
+                        "sent_mb": selected * param_mb,
+                        "round_seconds": _time.monotonic() - start,
+                    },
+                )
         return {"performance": self._stat}
 
     def _evaluate(self, global_params) -> dict:
@@ -429,15 +455,28 @@ class SpmdFedAvgSession:
             os.path.join(save_dir, "round_record.json"), "wt", encoding="utf8"
         ) as f:
             json.dump(self._stat, f)
-        model_dir = os.path.join(self.config.save_dir, "aggregated_model")
-        os.makedirs(model_dir, exist_ok=True)
-        host_params = {k: np.asarray(v) for k, v in global_params.items()}
-        np.savez(os.path.join(model_dir, f"round_{round_number}.npz"), **host_params)
-        if metric["accuracy"] > self._max_acc:
-            self._max_acc = metric["accuracy"]
+        best_path = os.path.join(save_dir, "best_global_model.npz")
+        if self._ckpt_queued_round == round_number:
+            # async path (base run loop): round_N.npz was queued right after
+            # the round program returned; promoting it to best is a file
+            # copy, not a second device fetch
+            if metric["accuracy"] > self._max_acc:
+                self._max_acc = metric["accuracy"]
+                self._ckpt.copy_last_to(best_path)
+        else:
+            # sessions that override run() (OBD, Shapley) checkpoint here,
+            # synchronously — their loops have no pre-donation barrier for
+            # a background fetch (the sparse sessions reuse the base run()
+            # and take the async branch above)
+            model_dir = os.path.join(self.config.save_dir, "aggregated_model")
+            os.makedirs(model_dir, exist_ok=True)
+            host_params = {k: np.asarray(v) for k, v in global_params.items()}
             np.savez(
-                os.path.join(save_dir, "best_global_model.npz"), **host_params
+                os.path.join(model_dir, f"round_{round_number}.npz"), **host_params
             )
+            if metric["accuracy"] > self._max_acc:
+                self._max_acc = metric["accuracy"]
+                np.savez(best_path, **host_params)
 
     @property
     def performance_stat(self) -> dict:

@@ -1,0 +1,108 @@
+"""Async round checkpointing.
+
+The reference writes its per-round global model synchronously on the server
+sweep thread (``aggregation_server.py:109-114`` via ``ModelCache``).  On the
+SPMD fast path that write sits directly on the round loop: a device→host
+fetch of the full model plus an ``np.savez`` per round — negligible for
+LeNet5, but at ViT/BERT scale it is tens of milliseconds of HBM→host
+transfer plus disk IO serialized with the next round's dispatch.
+
+:class:`AsyncCheckpointWriter` moves both off the critical path: the round
+loop hands over the (device-resident) param dict and continues; a single
+background thread fetches and writes.  One write is in flight at a time
+(a new save waits for the previous one — bounds host memory to one model
+copy), files land via atomic rename so a crashed run never leaves a torn
+``round_N.npz`` for resume to trip on, and ``wait()`` (called at run end
+and on errors) re-raises any background failure rather than swallowing it.
+"""
+
+import os
+import threading
+
+import numpy as np
+
+
+class AsyncCheckpointWriter:
+    """Background npz writer; at most one save in flight.
+
+    Donation caveat: if the arrays handed to :meth:`save_npz` will be
+    DONATED to a later jitted call (the SPMD round loop donates the old
+    global params), the caller must :meth:`wait` before that call — the
+    background fetch must win the race with XLA reusing the buffer.
+    """
+
+    def __init__(self) -> None:
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+        self._last_path: str | None = None
+
+    def _submit(self, fn) -> None:
+        self.wait()
+
+        def _run() -> None:
+            try:
+                fn()
+            except BaseException as exc:  # surfaced by the next wait()
+                self._error = exc
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+
+    def save_npz(self, path: str, params: dict) -> None:
+        """Queue ``params`` (mapping name → array, device or host) to be
+        written to ``path`` as npz.  Blocks only if the previous save is
+        still running."""
+        # start the device→host copies without blocking this thread; the
+        # writer thread's np.asarray then completes them
+        for value in params.values():
+            copy_async = getattr(value, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+
+        def _write() -> None:
+            host = {k: np.asarray(v) for k, v in params.items()}
+            tmp = f"{path}.tmp.npz"
+            with open(tmp, "wb") as f:
+                np.savez(f, **host)
+            os.replace(tmp, path)
+
+        self._submit(_write)
+        self._last_path = path
+
+    def copy_last_to(self, path: str) -> None:
+        """Queue a file copy of the most recently saved checkpoint to
+        ``path`` — e.g. promote ``round_N.npz`` to ``best_global_model.npz``
+        without a second device fetch."""
+        source = self._last_path
+        assert source is not None, "no checkpoint saved yet"
+        import shutil
+
+        def _copy() -> None:
+            tmp = f"{path}.tmp.npz"
+            shutil.copyfile(source, tmp)
+            os.replace(tmp, path)
+
+        self._submit(_copy)
+
+    def wait(self) -> None:
+        """Block until the in-flight save (if any) finishes; re-raise its
+        error, if it had one."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            error, self._error = self._error, None
+            raise error
+
+    def __enter__(self) -> "AsyncCheckpointWriter":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        # on clean exit surface background errors; on exception just drain
+        if exc_info[0] is None:
+            self.wait()
+        else:
+            try:
+                self.wait()
+            except Exception:
+                pass
