@@ -49,6 +49,7 @@ class TaskContext:
     workers: list = dataclasses.field(default_factory=list)
     practitioners: list = dataclasses.field(default_factory=list)
     timer: TimeCounter = dataclasses.field(default_factory=TimeCounter)
+    spmd_result: Any = None  # set by the SPMD session thread (task mode)
 
     def aborted(self) -> bool:
         return self.abort_event.is_set()
@@ -158,6 +159,25 @@ def _spawn(ctx: TaskContext) -> None:
         thread.start()
 
 
+def _remap_sv(result: dict, practitioners) -> dict:
+    """Remap per-round Shapley dicts from worker ids to practitioner ids
+    (reference ``get_training_result``, ``training.py:156-167``)."""
+    worker_to_practitioner = {
+        p.worker_id: p.practitioner_id for p in practitioners
+    }
+    for key in ("sv", "sv_S"):
+        if key not in result:
+            continue
+        result[key] = {
+            round_number: {
+                worker_to_practitioner[int(w)]: value
+                for w, value in round_sv.items()
+            }
+            for round_number, round_sv in result[key].items()
+        }
+    return result
+
+
 def _harvest(ctx: TaskContext) -> dict:
     for thread in ctx.threads:
         thread.join()
@@ -166,21 +186,13 @@ def _harvest(ctx: TaskContext) -> dict:
     get_logger().info(
         "training took %.2f seconds", ctx.timer.elapsed_seconds()
     )
+    if ctx.server is None:  # SPMD session task
+        return ctx.spmd_result
     result: dict = {"performance": ctx.server.performance_stat}
     sv = getattr(getattr(ctx.server, "algorithm", None), "shapley_values", None)
     if sv:
-        # remap worker ids back to practitioner ids (reference
-        # ``get_training_result``, training.py:156-167)
-        worker_to_practitioner = {
-            p.worker_id: p.practitioner_id for p in ctx.practitioners
-        }
-        result["sv"] = {
-            round_number: {
-                worker_to_practitioner[w]: value for w, value in round_sv.items()
-            }
-            for round_number, round_sv in sv.items()
-        }
-    return result
+        result["sv"] = sv
+    return _remap_sv(result, ctx.practitioners)
 
 
 def train(
@@ -209,72 +221,96 @@ def train(
         return _run_task(ctx, return_task_id=return_task_id, task_id=task_id)
 
 
+def _make_spmd_session(ctx: TaskContext):
+    algo = ctx.config.distributed_algorithm
+    from .parallel.spmd import SpmdFedAvgSession, SpmdSignSGDSession
+
+    session_args = (
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+    if algo == "fed_avg":
+        session = SpmdFedAvgSession(*session_args)
+    elif algo == "fed_paq":
+        level = int(
+            ctx.config.endpoint_kwargs.get("worker", {}).get(
+                "quantization_level", 255
+            )
+        )
+        session = SpmdFedAvgSession(*session_args, quantization_level=level)
+    elif algo == "sign_SGD":
+        session = SpmdSignSGDSession(*session_args)
+    elif algo in ("fed_obd", "fed_obd_sq"):
+        from .parallel.spmd_obd import SpmdFedOBDSession
+
+        session = SpmdFedOBDSession(
+            *session_args, codec="qsgd" if algo == "fed_obd_sq" else "nnadq"
+        )
+    elif algo in ("fed_gnn", "fed_gcn"):
+        from .parallel.spmd_gnn import SpmdFedGNNSession
+
+        session = SpmdFedGNNSession(
+            *session_args,
+            share_feature=True if algo == "fed_gcn" else None,
+        )
+    elif algo == "fed_aas":
+        from .parallel.spmd_gnn import SpmdFedAASSession
+
+        session = SpmdFedAASSession(*session_args)
+    elif algo == "fed_dropout_avg":
+        from .parallel.spmd_sparse import SpmdFedDropoutAvgSession
+
+        session = SpmdFedDropoutAvgSession(*session_args)
+    elif algo == "single_model_afd":
+        from .parallel.spmd_sparse import SpmdSMAFDSession
+
+        session = SpmdSMAFDSession(*session_args)
+    elif algo in (
+        "GTG_shapley_value",
+        "multiround_shapley_value",
+        "Hierarchical_shapley_value",
+    ):
+        from .parallel.spmd_shapley import SpmdShapleySession
+
+        session = SpmdShapleySession(*session_args)
+    else:
+        raise NotImplementedError(
+            f"no SPMD round program for {algo!r} (every built-in method "
+            "has one; for custom registrations drop executor=spmd and "
+            "use the threaded executor)"
+        )
+    return session
+
+
 def _run_task(ctx: TaskContext, return_task_id: bool, task_id: Any) -> dict | Any:
     if ctx.config.executor == "spmd":
-        algo = ctx.config.distributed_algorithm
-        from .parallel.spmd import SpmdFedAvgSession, SpmdSignSGDSession
-
-        session_args = (
-            ctx.config,
-            ctx.dataset_collection,
-            ctx.model_ctx,
-            ctx.engine,
-            ctx.practitioners,
-        )
-        if algo == "fed_avg":
-            session = SpmdFedAvgSession(*session_args)
-        elif algo == "fed_paq":
-            level = int(
-                ctx.config.endpoint_kwargs.get("worker", {}).get(
-                    "quantization_level", 255
-                )
-            )
-            session = SpmdFedAvgSession(*session_args, quantization_level=level)
-        elif algo == "sign_SGD":
-            session = SpmdSignSGDSession(*session_args)
-        elif algo in ("fed_obd", "fed_obd_sq"):
-            from .parallel.spmd_obd import SpmdFedOBDSession
-
-            session = SpmdFedOBDSession(
-                *session_args, codec="qsgd" if algo == "fed_obd_sq" else "nnadq"
-            )
-        elif algo in ("fed_gnn", "fed_gcn"):
-            from .parallel.spmd_gnn import SpmdFedGNNSession
-
-            session = SpmdFedGNNSession(
-                *session_args,
-                share_feature=True if algo == "fed_gcn" else None,
-            )
-        elif algo == "fed_aas":
-            from .parallel.spmd_gnn import SpmdFedAASSession
-
-            session = SpmdFedAASSession(*session_args)
-        elif algo == "fed_dropout_avg":
-            from .parallel.spmd_sparse import SpmdFedDropoutAvgSession
-
-            session = SpmdFedDropoutAvgSession(*session_args)
-        elif algo == "single_model_afd":
-            from .parallel.spmd_sparse import SpmdSMAFDSession
-
-            session = SpmdSMAFDSession(*session_args)
-        elif algo in (
-            "GTG_shapley_value",
-            "multiround_shapley_value",
-            "Hierarchical_shapley_value",
-        ):
-            from .parallel.spmd_shapley import SpmdShapleySession
-
-            session = SpmdShapleySession(*session_args)
-        else:
-            raise NotImplementedError(
-                f"no SPMD round program for {algo!r} (every built-in method "
-                "has one; for custom registrations drop executor=spmd and "
-                "use the threaded executor)"
-            )
-        result = session.run()
-        get_logger().info("training took %.2f seconds", ctx.timer.elapsed_seconds())
+        session = _make_spmd_session(ctx)
         if return_task_id:
-            raise NotImplementedError("spmd executor is synchronous")
+            # task mode: the whole session runs on one background thread —
+            # the single-controller analogue of the reference's background
+            # process pool (its concurrent-task API, ``training.py:96-133``)
+            def run_session() -> None:
+                try:
+                    ctx.spmd_result = _remap_sv(session.run(), ctx.practitioners)
+                except Exception as exc:  # noqa: BLE001 — surfaced at harvest
+                    get_logger().exception("spmd task failed")
+                    ctx.errors.append(exc)
+
+            thread = threading.Thread(
+                target=run_session,
+                name=f"spmd:{ctx.config.distributed_algorithm}",
+                daemon=True,
+            )
+            ctx.threads.append(thread)
+            thread.start()
+            with _tasks_lock:
+                tasks[task_id] = ctx
+            return task_id
+        result = _remap_sv(session.run(), ctx.practitioners)
+        get_logger().info("training took %.2f seconds", ctx.timer.elapsed_seconds())
         return result
     _spawn(ctx)
     if return_task_id:

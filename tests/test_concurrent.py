@@ -29,3 +29,37 @@ def test_concurrent_tasks(tmp_session_dir):
     for task_id in task_ids:
         result = get_training_result(task_id)
         assert result["performance"]
+
+
+def test_concurrent_spmd_tasks(tmp_session_dir):
+    """Task mode works for the SPMD executor too: each task's whole-round
+    program runs on a background thread; results come back through the same
+    get_training_result API (with Shapley remapping where applicable)."""
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        distributed_algorithm="fed_avg",
+        executor="spmd",
+        worker_number=2,
+        batch_size=16,
+        round=1,
+        epoch=1,
+        learning_rate=0.05,
+        dataset_kwargs={"train_size": 64, "val_size": 16, "test_size": 32},
+    )
+    # each task needs its own save_dir (concurrent sessions would race on
+    # the same checkpoint/record files)
+    task_ids = [
+        train(
+            config.replace(
+                save_dir=str(tmp_session_dir / f"spmd_task_{i}"),
+                log_file=str(tmp_session_dir / f"spmd_task_{i}.log"),
+            ),
+            return_task_id=True,
+        )
+        for i in range(2)
+    ]
+    assert len(set(task_ids)) == 2
+    for task_id in task_ids:
+        result = get_training_result(task_id)
+        assert result["performance"][1]["test_count"] == 32.0
