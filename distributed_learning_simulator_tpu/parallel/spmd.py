@@ -463,28 +463,28 @@ class SpmdFedAvgSession:
             os.path.join(save_dir, "round_record.json"), "wt", encoding="utf8"
         ) as f:
             json.dump(self._stat, f)
-        best_path = os.path.join(save_dir, "best_global_model.npz")
-        if self._ckpt_queued_round == round_number:
-            # async path (base run loop): round_N.npz was queued right after
-            # the round program returned; promoting it to best is a file
-            # copy, not a second device fetch
-            if metric["accuracy"] > self._max_acc:
-                self._max_acc = metric["accuracy"]
-                self._ckpt.copy_last_to(best_path)
-        else:
-            # sessions that override run() (OBD, Shapley) checkpoint here,
-            # synchronously — their loops have no pre-donation barrier for
-            # a background fetch (the sparse sessions reuse the base run()
-            # and take the async branch above)
+        if self._ckpt_queued_round != round_number:
+            # the base run loop queues round_N.npz right after the round
+            # program returns (overlapping evaluation); sessions that
+            # override run() (OBD, Shapley) queue it here instead.  Async is
+            # safe for them too: the params they record (OBD's exact
+            # aggregate, Shapley's weighted average) are fresh arrays their
+            # round programs never donate, and the writer holds a reference
+            # until the fetch completes.  Their run() loops flush through
+            # the writer's context manager.
             model_dir = os.path.join(self.config.save_dir, "aggregated_model")
             os.makedirs(model_dir, exist_ok=True)
-            host_params = {k: np.asarray(v) for k, v in global_params.items()}
-            np.savez(
-                os.path.join(model_dir, f"round_{round_number}.npz"), **host_params
+            self._ckpt.save_npz(
+                os.path.join(model_dir, f"round_{round_number}.npz"),
+                dict(global_params),
             )
-            if metric["accuracy"] > self._max_acc:
-                self._max_acc = metric["accuracy"]
-                np.savez(best_path, **host_params)
+        # promoting the round checkpoint to best is a file copy chained on
+        # the writer queue, not a second device fetch
+        if metric["accuracy"] > self._max_acc:
+            self._max_acc = metric["accuracy"]
+            self._ckpt.copy_last_to(
+                os.path.join(save_dir, "best_global_model.npz")
+            )
 
     @property
     def performance_stat(self) -> dict:

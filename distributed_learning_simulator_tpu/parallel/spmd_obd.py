@@ -302,31 +302,32 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 k: float(np.asarray(v)) for k, v in metrics.items()
             }
 
-        # ---- phase 1: rounds with random selection + block dropout ----
-        for round_number in range(1, config.round + 1):
-            exact, train_params, met = step(
-                self._phase1_fn, train_params, self._select_weights(round_number)
-            )
-            metric = self._evaluate(exact)
-            self._record_obd(round_number, metric, met, exact, save_dir)
-            if early_stop and not self._has_improvement():
-                get_logger().info("phase 1 convergent, switching early")
-                break
-        get_logger().info("switch to phase 2")
+        with self._ckpt:  # flush async round checkpoints at exit
+            # ---- phase 1: rounds with random selection + block dropout ----
+            for round_number in range(1, config.round + 1):
+                exact, train_params, met = step(
+                    self._phase1_fn, train_params, self._select_weights(round_number)
+                )
+                metric = self._evaluate(exact)
+                self._record_obd(round_number, metric, met, exact, save_dir)
+                if early_stop and not self._has_improvement():
+                    get_logger().info("phase 1 convergent, switching early")
+                    break
+            get_logger().info("switch to phase 2")
 
-        # ---- phase 2: per-epoch aggregation over all clients ----
-        if self._phase2_fn is None:
-            self._phase2_fn = self._build_phase_fn(phase_two=True)
-        for _ in range(second_phase_epoch):
-            exact, train_params, met = step(
-                self._phase2_fn, train_params, self._all_weights()
-            )
-            metric = self._evaluate(exact)  # check_acc semantics
-            stat_key = max(self._stat) + 1 if self._stat else 1
-            self._record_obd(stat_key, metric, met, exact, save_dir)
-            if early_stop and not self._has_improvement():
-                get_logger().info("phase 2 plateau, stopping")
-                break
+            # ---- phase 2: per-epoch aggregation over all clients ----
+            if self._phase2_fn is None:
+                self._phase2_fn = self._build_phase_fn(phase_two=True)
+            for _ in range(second_phase_epoch):
+                exact, train_params, met = step(
+                    self._phase2_fn, train_params, self._all_weights()
+                )
+                metric = self._evaluate(exact)  # check_acc semantics
+                stat_key = max(self._stat) + 1 if self._stat else 1
+                self._record_obd(stat_key, metric, met, exact, save_dir)
+                if early_stop and not self._has_improvement():
+                    get_logger().info("phase 2 plateau, stopping")
+                    break
         return {"performance": self._stat}
 
     # ------------------------------------------------------------------

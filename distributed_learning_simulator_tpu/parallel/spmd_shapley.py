@@ -146,6 +146,22 @@ class SpmdShapleySession(SpmdFedAvgSession):
         rng = jax.random.PRNGKey(config.seed)
         choose_best = bool(config.algorithm_kwargs.get("choose_best_subset", False))
 
+        with self._ckpt:  # flush async round checkpoints at exit
+            self._run_rounds(config, global_params, rng, choose_best, save_dir)
+
+        with open(
+            os.path.join(config.save_dir, "shapley_values.json"),
+            "wt",
+            encoding="utf8",
+        ) as f:
+            json.dump({str(k): v for k, v in self.shapley_values.items()}, f)
+        return {
+            "performance": {k: v for k, v in self._stat.items() if k > 0},
+            "sv": self.shapley_values,
+            "sv_S": self.shapley_values_S,
+        }
+
+    def _run_rounds(self, config, global_params, rng, choose_best, save_dir):
         for round_number in range(1, config.round + 1):
             weights = jax.device_put(
                 self._select_weights(round_number), self._client_sharding
@@ -195,15 +211,3 @@ class SpmdShapleySession(SpmdFedAvgSession):
             )
             metric = self._evaluate(global_params)
             self._record(round_number, metric, global_params, save_dir)
-
-        with open(
-            os.path.join(config.save_dir, "shapley_values.json"),
-            "wt",
-            encoding="utf8",
-        ) as f:
-            json.dump({str(k): v for k, v in self.shapley_values.items()}, f)
-        return {
-            "performance": {k: v for k, v in self._stat.items() if k > 0},
-            "sv": self.shapley_values,
-            "sv_S": self.shapley_values_S,
-        }

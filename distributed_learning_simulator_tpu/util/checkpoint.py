@@ -9,55 +9,80 @@ transfer plus disk IO serialized with the next round's dispatch.
 
 :class:`AsyncCheckpointWriter` moves both off the critical path: the round
 loop hands over the (device-resident) param dict and continues; a single
-background thread fetches and writes.  One write is in flight at a time
-(a new save waits for the previous one — bounds host memory to one model
-copy), files land via atomic rename so a crashed run never leaves a torn
-``round_N.npz`` for resume to trip on, and ``wait()`` (called at run end
-and on errors) re-raises any background failure rather than swallowing it.
+background worker thread fetches and writes, draining a FIFO so a
+best-model promotion queued right after a save chains behind it without
+blocking the caller.  The queue is bounded to one waiting job, capping
+live checkpoint state at two model copies (one being written + one
+queued); files land via atomic rename so a crashed run never leaves a
+torn ``round_N.npz`` for resume to trip on.  A background failure is
+re-raised promptly at the next queue operation (fail-fast, first error
+wins) and again by ``wait()`` / the ``with`` block at run end.
 """
 
 import os
+import queue
 import threading
-
-import numpy as np
 
 
 class AsyncCheckpointWriter:
-    """Background npz writer; at most one save in flight.
+    """Background npz writer: one worker thread, bounded FIFO of jobs.
 
     Donation caveat: if the arrays handed to :meth:`save_npz` will be
-    DONATED to a later jitted call (the SPMD round loop donates the old
+    DONATED to a later jitted call (the SPMD fed_avg loop donates the old
     global params), the caller must :meth:`wait` before that call — the
     background fetch must win the race with XLA reusing the buffer.
+    Arrays that are never donated (OBD's exact aggregate, Shapley's
+    weighted average) need no barrier: the queued closure keeps them alive.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, max_pending: int = 1) -> None:
+        self._jobs: queue.Queue = queue.Queue(maxsize=max_pending)
         self._thread: threading.Thread | None = None
         self._error: BaseException | None = None
         self._last_path: str | None = None
+        self._last_save_ok: list[bool] = [True]
 
-    def _submit(self, fn) -> None:
-        self.wait()
-
-        def _run() -> None:
+    def _worker(self) -> None:
+        while True:
+            job = self._jobs.get()
             try:
-                fn()
-            except BaseException as exc:  # surfaced by the next wait()
-                self._error = exc
+                if job is not None:
+                    job()
+            except BaseException as exc:
+                if self._error is None:  # first error wins
+                    self._error = exc
+            finally:
+                self._jobs.task_done()
+            if job is None:  # shutdown sentinel from wait()
+                return
 
-        self._thread = threading.Thread(target=_run, daemon=True)
-        self._thread.start()
+    def _raise_pending_error(self) -> None:
+        if self._error is not None:
+            error, self._error = self._error, None
+            raise error
+
+    def _submit(self, job) -> None:
+        # fail fast: a checkpoint that failed in the background aborts the
+        # run at the next attempted save, not hours later at run end
+        self._raise_pending_error()
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._worker, daemon=True)
+            self._thread.start()
+        self._jobs.put(job)  # blocks only when max_pending jobs are queued
 
     def save_npz(self, path: str, params: dict) -> None:
         """Queue ``params`` (mapping name → array, device or host) to be
-        written to ``path`` as npz.  Blocks only if the previous save is
-        still running."""
+        written to ``path`` as npz."""
+        import numpy as np
+
         # start the device→host copies without blocking this thread; the
-        # writer thread's np.asarray then completes them
+        # worker's np.asarray then completes them
         for value in params.values():
             copy_async = getattr(value, "copy_to_host_async", None)
             if copy_async is not None:
                 copy_async()
+
+        succeeded = [False]  # per-save flag read by a chained promotion
 
         def _write() -> None:
             host = {k: np.asarray(v) for k, v in params.items()}
@@ -65,19 +90,27 @@ class AsyncCheckpointWriter:
             with open(tmp, "wb") as f:
                 np.savez(f, **host)
             os.replace(tmp, path)
+            succeeded[0] = True
 
         self._submit(_write)
         self._last_path = path
+        self._last_save_ok = succeeded
 
     def copy_last_to(self, path: str) -> None:
         """Queue a file copy of the most recently saved checkpoint to
         ``path`` — e.g. promote ``round_N.npz`` to ``best_global_model.npz``
-        without a second device fetch."""
+        without a second device fetch.  Runs after the save it refers to
+        (same FIFO), without blocking the caller."""
         source = self._last_path
         assert source is not None, "no checkpoint saved yet"
+        save_ok = self._last_save_ok
         import shutil
 
         def _copy() -> None:
+            if not save_ok[0]:
+                # the save that produced ``source`` failed — don't promote
+                # a stale file a previous run may have left at that path
+                return
             tmp = f"{path}.tmp.npz"
             shutil.copyfile(source, tmp)
             os.replace(tmp, path)
@@ -85,14 +118,14 @@ class AsyncCheckpointWriter:
         self._submit(_copy)
 
     def wait(self) -> None:
-        """Block until the in-flight save (if any) finishes; re-raise its
-        error, if it had one."""
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
-        if self._error is not None:
-            error, self._error = self._error, None
-            raise error
+        """Block until all queued jobs finish and the worker thread exits;
+        re-raise the first background error, if any."""
+        self._jobs.join()
+        if self._thread is not None and self._thread.is_alive():
+            self._jobs.put(None)  # stop the worker: no thread leak across
+            self._thread.join()  # sessions in long-lived processes
+        self._thread = None
+        self._raise_pending_error()
 
     def __enter__(self) -> "AsyncCheckpointWriter":
         return self

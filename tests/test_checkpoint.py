@@ -46,6 +46,47 @@ def test_writer_error_surfaces(tmp_path):
     assert (tmp_path / "ok.npz").is_file()
 
 
+def test_writer_fails_fast_and_keeps_first_error(tmp_path):
+    """A failed background save aborts at the next queue operation (not at
+    run end) with the root cause, and a promotion chained behind the failed
+    save must not copy a stale file left at the source path."""
+    import time
+
+    writer = AsyncCheckpointWriter()
+    bad = tmp_path / "missing" / "round_1.npz"
+    stale = tmp_path / "round_stale.npz"
+    np.savez(str(stale), a=np.arange(3.0))
+    error = None
+    try:
+        writer.save_npz(str(bad), {"a": np.zeros(2)})
+        writer._last_path = str(stale)  # simulate resume dir w/ stale file
+        writer.copy_last_to(str(tmp_path / "best.npz"))
+    except FileNotFoundError as exc:  # error can land before any queue op
+        error = exc
+    deadline = time.monotonic() + 5.0
+    while error is None and time.monotonic() < deadline:
+        try:
+            writer.save_npz(str(tmp_path / "next.npz"), {"a": np.zeros(2)})
+            time.sleep(0.02)
+        except FileNotFoundError as exc:
+            error = exc
+    assert error is not None, "background save error never surfaced"
+    assert "missing" in str(error)  # the root cause, not the follow-up copy
+    try:
+        writer.wait()
+    except FileNotFoundError:
+        pass
+    # the copy job saw the failed save and skipped the stale promotion
+    assert not (tmp_path / "best.npz").exists()
+
+
+def test_writer_worker_thread_stops_after_wait(tmp_path):
+    writer = AsyncCheckpointWriter()
+    with writer:
+        writer.save_npz(str(tmp_path / "a.npz"), {"a": np.zeros(2)})
+    assert writer._thread is None
+
+
 def test_resume_ignores_orphan_checkpoint(tmp_session_dir):
     """A trailing round_N.npz with no round_record entry (crash between the
     async checkpoint write and the stats row) must not be resumed from."""
