@@ -400,7 +400,7 @@ class SpmdFedAvgSession:
                 )
                 # old global_params are donated into the round program —
                 # any pending background fetch of them must finish first
-                self._ckpt.wait()
+                self._ckpt.barrier()
                 global_params, train_metrics = self._round_fn(
                     global_params, weights, client_rngs
                 )

@@ -62,6 +62,9 @@ class SpmdFedGNNSession:
         )
         self._stat: dict[int, dict] = {}
         self._max_acc = 0.0
+        from ..util.checkpoint import AsyncCheckpointWriter
+
+        self._ckpt = AsyncCheckpointWriter()
         self._prepare_data(practitioners)
         self._round_fn = self._build_round_fn()
 
@@ -332,45 +335,59 @@ class SpmdFedGNNSession:
         )
         rng = jax.random.PRNGKey(config.seed)
         test_batch = make_graph_batch(self.dc.get_dataset(Phase.Test))
-        for round_number in range(1, config.round + 1):
-            self._before_round(round_number)
-            rng, round_rng = jax.random.split(rng)
-            client_rngs = jax.device_put(
-                jax.random.split(round_rng, self.n_slots), self._client_sharding
-            )
-            global_params, train_metrics = self._round_fn(
-                global_params, weights, client_rngs
-            )
-            metric = summarize_metrics(
-                self.engine.evaluate_single(global_params, test_batch)
-            )
-            mb = self._round_payload_bytes / 1e6
-            self._stat[round_number] = {
-                "test_accuracy": metric["accuracy"],
-                "test_loss": metric["loss"],
-                "test_count": metric["count"],
-                "received_mb": mb,
-                "sent_mb": mb,
-            }
-            get_logger().info(
-                "round: %d, test accuracy %.4f loss %.4f (spmd gnn, %.3f MB exchanged)",
-                round_number,
-                metric["accuracy"],
-                metric["loss"],
-                mb,
-            )
-            import json
-
-            with open(
-                os.path.join(save_dir, "round_record.json"), "wt", encoding="utf8"
-            ) as f:
-                json.dump(self._stat, f)
-            if metric["accuracy"] > self._max_acc:
-                self._max_acc = metric["accuracy"]
-                np.savez(
-                    os.path.join(save_dir, "best_global_model.npz"),
-                    **{k: np.asarray(v) for k, v in global_params.items()},
+        model_dir = os.path.join(config.save_dir, "aggregated_model")
+        os.makedirs(model_dir, exist_ok=True)
+        with self._ckpt:  # flush async round checkpoints at exit
+            for round_number in range(1, config.round + 1):
+                self._before_round(round_number)
+                rng, round_rng = jax.random.split(rng)
+                client_rngs = jax.device_put(
+                    jax.random.split(round_rng, self.n_slots), self._client_sharding
                 )
+                # old global_params are donated into the round program —
+                # any pending background fetch of them must finish first
+                self._ckpt.barrier()
+                global_params, train_metrics = self._round_fn(
+                    global_params, weights, client_rngs
+                )
+                # queued now so the fetch/write overlaps the evaluation
+                self._ckpt.save_npz(
+                    os.path.join(model_dir, f"round_{round_number}.npz"),
+                    global_params,
+                )
+                metric = summarize_metrics(
+                    self.engine.evaluate_single(global_params, test_batch)
+                )
+                mb = self._round_payload_bytes / 1e6
+                self._stat[round_number] = {
+                    "test_accuracy": metric["accuracy"],
+                    "test_loss": metric["loss"],
+                    "test_count": metric["count"],
+                    "received_mb": mb,
+                    "sent_mb": mb,
+                }
+                get_logger().info(
+                    "round: %d, test accuracy %.4f loss %.4f "
+                    "(spmd gnn, %.3f MB exchanged)",
+                    round_number,
+                    metric["accuracy"],
+                    metric["loss"],
+                    mb,
+                )
+                import json
+
+                with open(
+                    os.path.join(save_dir, "round_record.json"),
+                    "wt",
+                    encoding="utf8",
+                ) as f:
+                    json.dump(self._stat, f)
+                if metric["accuracy"] > self._max_acc:
+                    self._max_acc = metric["accuracy"]
+                    # file copy of the queued round checkpoint, no 2nd fetch
+                    self._ckpt.copy_last_to(
+                        os.path.join(save_dir, "best_global_model.npz")
+                    )
         return {"performance": self._stat}
 
     def _before_round(self, round_number: int) -> None:

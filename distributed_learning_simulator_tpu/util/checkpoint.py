@@ -117,13 +117,21 @@ class AsyncCheckpointWriter:
 
         self._submit(_copy)
 
+    def barrier(self) -> None:
+        """Block until all queued jobs finish (the pre-donation barrier in
+        round loops); re-raise the first background error, if any.  Keeps
+        the worker thread alive for the next round's save."""
+        self._jobs.join()
+        self._raise_pending_error()
+
     def wait(self) -> None:
-        """Block until all queued jobs finish and the worker thread exits;
-        re-raise the first background error, if any."""
+        """barrier() + stop the worker thread — called at run end (the
+        ``with`` block) so long-lived processes don't leak one thread per
+        session."""
         self._jobs.join()
         if self._thread is not None and self._thread.is_alive():
-            self._jobs.put(None)  # stop the worker: no thread leak across
-            self._thread.join()  # sessions in long-lived processes
+            self._jobs.put(None)  # shutdown sentinel
+            self._thread.join()
         self._thread = None
         self._raise_pending_error()
 
