@@ -61,10 +61,15 @@ def put_sharded(host_data, sharding):
     )
 
 
-def client_slots(worker_number: int, mesh: Mesh) -> int:
-    """Pad the client count to a multiple of the mesh's client axis so every
-    device carries the same number of client slots (zero-weight padding
-    mirrors the reference's time-multiplexing of workers onto devices,
-    ``algorithm_factory.py:38-58``)."""
-    n = mesh.shape["clients"]
+def client_slots(
+    worker_number: int, mesh: Mesh, axes: tuple[str, ...] = ("clients",)
+) -> int:
+    """Pad the client count to a multiple of the slot axes' total size so
+    every device carries the same number of client slots (zero-weight
+    padding mirrors the reference's time-multiplexing of workers onto
+    devices, ``algorithm_factory.py:38-58``).  FSDP sessions partition
+    slots over ``("clients", "model")``."""
+    n = 1
+    for axis in axes:
+        n *= mesh.shape[axis]
     return ((worker_number + n - 1) // n) * n

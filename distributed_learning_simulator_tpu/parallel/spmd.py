@@ -143,7 +143,28 @@ class SpmdFedAvgSession:
         self.model_ctx = model_ctx
         self.engine = engine
         self.mesh = mesh if mesh is not None else make_mesh()
-        self.n_slots = client_slots(config.worker_number, self.mesh)
+        # FSDP over the inner ``model`` axis (SURVEY.md §7 item 10: "inner
+        # mesh axis for TP/FSDP of larger client models"): client slots
+        # partition over BOTH axes (every device trains clients), global
+        # params are STORED sharded per-leaf over ``model``, all-gathered on
+        # use and reduce-scattered after aggregation.  Enabled whenever the
+        # mesh has a model axis; ``algorithm_kwargs.model_sharding: none``
+        # opts out (params replicated, model axis idle for the base method).
+        self._model_axis = int(self.mesh.shape.get("model", 1))
+        model_sharding = str(
+            config.algorithm_kwargs.get("model_sharding", "fsdp")
+        )
+        if model_sharding not in ("fsdp", "none"):
+            raise ValueError(
+                f"model_sharding must be 'fsdp' or 'none', got {model_sharding!r}"
+            )
+        self._fsdp = (
+            self._model_axis > 1
+            and model_sharding == "fsdp"
+            and type(self) is SpmdFedAvgSession
+        )
+        slot_axes = ("clients", "model") if self._fsdp else ("clients",)
+        self.n_slots = client_slots(config.worker_number, self.mesh, slot_axes)
         self.quantization_level = quantization_level
         self.client_chunk = client_chunk or int(
             config.algorithm_kwargs.get("client_chunk", 0)
@@ -161,16 +182,54 @@ class SpmdFedAvgSession:
         )
 
         # ---- shardings ----
-        self._client_sharding = NamedSharding(self.mesh, P("clients"))
+        self._slot_spec = P(("clients", "model")) if self._fsdp else P("clients")
+        self._client_sharding = NamedSharding(self.mesh, self._slot_spec)
         self._replicated = NamedSharding(self.mesh, P())
+        template = jax.eval_shape(
+            lambda: self.engine.init_params(config.seed)
+        )
+        self._param_specs = {
+            k: self._leaf_spec(v.shape) for k, v in template.items()
+        }
+        self._param_shardings = {
+            k: NamedSharding(self.mesh, spec)
+            for k, spec in self._param_specs.items()
+        }
         from .mesh import put_sharded
 
         self._data = put_sharded(
-            self._data,
-            NamedSharding(self.mesh, P("clients")),
+            self._data, NamedSharding(self.mesh, self._slot_spec)
         )
 
         self._round_fn = self._build_round_fn()
+
+    def _leaf_spec(self, shape) -> P:
+        """FSDP layout rule: shard a param leaf's leading dim over the
+        ``model`` axis when it divides evenly, else keep it replicated."""
+        if self._fsdp and shape and shape[0] % self._model_axis == 0:
+            return P("model")
+        return P()
+
+    def _place_params(self, params):
+        """Place host params onto the per-leaf (possibly model-sharded)
+        layout — multi-host aware: each process contributes its addressable
+        slice (``put_sharded``), a plain device_put cannot target shards on
+        non-addressable devices."""
+        from .mesh import put_sharded
+
+        return {
+            k: put_sharded(v, self._param_shardings[k])
+            for k, v in params.items()
+        }
+
+    def _checkpointable(self, params):
+        """A view of ``params`` safe to fetch on this host for the npz
+        writer.  Single-process: any layout fetches fine.  On a multi-host
+        pod, model-sharded leaves span non-addressable devices — reshard
+        them to replicated (an all-gather) before handing to the writer."""
+        if not self._fsdp or jax.process_count() == 1:
+            return params
+        return jax.device_put(params, self._replicated)
 
     # ------------------------------------------------------------------
     def _build_round_fn(self):
@@ -220,6 +279,16 @@ class SpmdFedAvgSession:
             constants (hundreds of MB of program, slow/oversized compiles)."""
 
             def shard_body(global_params, data, weights, rngs):
+                params_in = global_params  # per-device (possibly sharded) view
+                if self._fsdp:
+                    # materialize full params for local training; XLA frees
+                    # the gathered copy after the last use
+                    global_params = {
+                        k: jax.lax.all_gather(v, "model", axis=0, tiled=True)
+                        if self._param_specs[k] != P()
+                        else v
+                        for k, v in global_params.items()
+                    }
                 slots_local = weights.shape[0]
                 mb = chunk_size(slots_local)
                 if mb == slots_local:
@@ -270,25 +339,45 @@ class SpmdFedAvgSession:
                         ),
                     )
                     (local_sum, metrics), _ = jax.lax.scan(chunk_body, init, chunks)
-                global_sum = jax.tree.map(
-                    lambda s: jax.lax.psum(s, axis_name="clients"), local_sum
+                slot_axes = (
+                    ("clients", "model") if self._fsdp else "clients"
                 )
-                total_weight = jax.lax.psum(jnp.sum(weights), axis_name="clients")
+
+                def reduce_leaf(key, s):
+                    if self._fsdp and self._param_specs[key] != P():
+                        # sum over clients, then reduce_scatter over model:
+                        # each device keeps only its param shard
+                        s = jax.lax.psum(s, axis_name="clients")
+                        return jax.lax.psum_scatter(
+                            s, "model", scatter_dimension=0, tiled=True
+                        )
+                    return jax.lax.psum(s, axis_name=slot_axes)
+
+                global_sum = {
+                    k: reduce_leaf(k, s) for k, s in local_sum.items()
+                }
+                total_weight = jax.lax.psum(jnp.sum(weights), axis_name=slot_axes)
                 new_global = jax.tree.map(
                     lambda s, g: (s / jnp.maximum(total_weight, 1e-12)).astype(g.dtype),
                     global_sum,
-                    global_params,
+                    params_in,
                 )
                 metrics = jax.tree.map(
-                    lambda m: jax.lax.psum(jnp.sum(m), axis_name="clients"), metrics
+                    lambda m: jax.lax.psum(jnp.sum(m), axis_name=slot_axes),
+                    metrics,
                 )
                 return new_global, metrics
 
             return shard_map_compat(
                 shard_body,
                 self.mesh,
-                in_specs=(P(), P("clients"), P("clients"), P("clients")),
-                out_specs=(P(), P()),
+                in_specs=(
+                    self._param_specs,
+                    self._slot_spec,
+                    self._slot_spec,
+                    self._slot_spec,
+                ),
+                out_specs=(self._param_specs, P()),
             )(global_params, data, weights, rngs)
 
         # donate the old global params: the round returns the new ones, so
@@ -355,16 +444,13 @@ class SpmdFedAvgSession:
                 )
                 get_logger().info("resumed from %s round %d", resume_dir, last)
                 params = {k: blob[k] for k in blob.files}
-                return jax.device_put(params, self._replicated), last + 1
+                return self._place_params(params), last + 1
         init_path = config.algorithm_kwargs.get("global_model_path")
         if init_path:
             blob = np.load(init_path)
             params = {k: blob[k] for k in blob.files}
-            return jax.device_put(params, self._replicated), 1
-        return (
-            jax.device_put(self.engine.init_params(config.seed), self._replicated),
-            1,
-        )
+            return self._place_params(params), 1
+        return self._place_params(self.engine.init_params(config.seed)), 1
 
     # wire-cost factor for the stat surface: fraction of full fp32 bytes a
     # client upload costs (fed_paq's 255-level QSGD packs 8 level bits + 1
@@ -408,7 +494,7 @@ class SpmdFedAvgSession:
                 # and disk write overlap the test-set evaluation below
                 self._ckpt.save_npz(
                     os.path.join(model_dir, f"round_{round_number}.npz"),
-                    global_params,
+                    self._checkpointable(global_params),
                 )
                 self._ckpt_queued_round = round_number
                 metric = self._evaluate(global_params)

@@ -232,22 +232,35 @@ def _make_spmd_session(ctx: TaskContext):
         ctx.engine,
         ctx.practitioners,
     )
+    # ``algorithm_kwargs.model_parallel: M`` shapes the mesh as
+    # (clients=devices/M, model=M) — on fed_avg this turns on FSDP param
+    # sharding over the model axis (parallel/spmd.py)
+    model_parallel = int(ctx.config.algorithm_kwargs.get("model_parallel", 1))
+    session_kwargs = {}
+    if model_parallel > 1:
+        from .parallel.mesh import make_mesh
+
+        session_kwargs["mesh"] = make_mesh(model_parallel=model_parallel)
     if algo == "fed_avg":
-        session = SpmdFedAvgSession(*session_args)
+        session = SpmdFedAvgSession(*session_args, **session_kwargs)
     elif algo == "fed_paq":
         level = int(
             ctx.config.endpoint_kwargs.get("worker", {}).get(
                 "quantization_level", 255
             )
         )
-        session = SpmdFedAvgSession(*session_args, quantization_level=level)
+        session = SpmdFedAvgSession(
+            *session_args, quantization_level=level, **session_kwargs
+        )
     elif algo == "sign_SGD":
-        session = SpmdSignSGDSession(*session_args)
+        session = SpmdSignSGDSession(*session_args, **session_kwargs)
     elif algo in ("fed_obd", "fed_obd_sq"):
         from .parallel.spmd_obd import SpmdFedOBDSession
 
         session = SpmdFedOBDSession(
-            *session_args, codec="qsgd" if algo == "fed_obd_sq" else "nnadq"
+            *session_args,
+            codec="qsgd" if algo == "fed_obd_sq" else "nnadq",
+            **session_kwargs,
         )
     elif algo in ("fed_gnn", "fed_gcn"):
         from .parallel.spmd_gnn import SpmdFedGNNSession
@@ -255,19 +268,20 @@ def _make_spmd_session(ctx: TaskContext):
         session = SpmdFedGNNSession(
             *session_args,
             share_feature=True if algo == "fed_gcn" else None,
+            **session_kwargs,
         )
     elif algo == "fed_aas":
         from .parallel.spmd_gnn import SpmdFedAASSession
 
-        session = SpmdFedAASSession(*session_args)
+        session = SpmdFedAASSession(*session_args, **session_kwargs)
     elif algo == "fed_dropout_avg":
         from .parallel.spmd_sparse import SpmdFedDropoutAvgSession
 
-        session = SpmdFedDropoutAvgSession(*session_args)
+        session = SpmdFedDropoutAvgSession(*session_args, **session_kwargs)
     elif algo == "single_model_afd":
         from .parallel.spmd_sparse import SpmdSMAFDSession
 
-        session = SpmdSMAFDSession(*session_args)
+        session = SpmdSMAFDSession(*session_args, **session_kwargs)
     elif algo in (
         "GTG_shapley_value",
         "multiround_shapley_value",
@@ -275,7 +289,7 @@ def _make_spmd_session(ctx: TaskContext):
     ):
         from .parallel.spmd_shapley import SpmdShapleySession
 
-        session = SpmdShapleySession(*session_args)
+        session = SpmdShapleySession(*session_args, **session_kwargs)
     else:
         raise NotImplementedError(
             f"no SPMD round program for {algo!r} (every built-in method "
