@@ -97,6 +97,31 @@ class ComputeEngine:
         out, _ = jax.lax.scan(body, init, batches)
         return out
 
+    def confusion_fn(self, params, batches):
+        """Confusion matrix ``[num_classes, num_classes]`` (rows = true,
+        cols = predicted) over scanned batches — the substrate for the
+        reference's ``use_slow_performance_metrics`` extras (per-class
+        accuracy, macro F1) computed on demand, off the fast path."""
+        num_classes = self.model_ctx.num_classes
+
+        cast = self.model_ctx._cast_for_compute  # same dtype as evaluate()
+
+        def body(acc, batch):
+            logits = self.model_ctx.apply(
+                cast(params), cast(batch["input"]), train=False
+            )
+            pred = jnp.argmax(logits, axis=-1)
+            true_oh = jax.nn.one_hot(batch["target"], num_classes)
+            pred_oh = jax.nn.one_hot(pred, num_classes)
+            mask = batch["mask"].astype(jnp.float32)
+            return acc + jnp.einsum(
+                "bt,bp->tp", true_oh * mask[:, None], pred_oh
+            ), None
+
+        init = jnp.zeros((num_classes, num_classes), jnp.float32)
+        acc, _ = jax.lax.scan(body, init, batches)
+        return acc
+
     def eval_single_fn(self, params, batch):
         loss, aux = self.model_ctx.loss(params, batch, train=False)
         return {
@@ -126,8 +151,38 @@ class ComputeEngine:
         return jax.jit(self.eval_fn)
 
     @functools.cached_property
+    def confusion(self):
+        return jax.jit(self.confusion_fn)
+
+    @functools.cached_property
     def evaluate_single(self):
         return jax.jit(self.eval_single_fn)
+
+
+def slow_metrics_from_confusion(confusion) -> dict[str, Any]:
+    """Per-class accuracy (recall) and macro F1 from a confusion matrix —
+    the ``use_slow_performance_metrics`` extras (the reference's toolbox
+    computes these via torchmetrics when the flag is on)."""
+    import numpy as np
+
+    cm = np.asarray(confusion, np.float64)
+    true_pos = np.diag(cm)
+    per_class_total = cm.sum(axis=1)
+    predicted = cm.sum(axis=0)
+    per_class_acc = true_pos / np.maximum(per_class_total, 1.0)
+    f1 = 2 * true_pos / np.maximum(per_class_total + predicted, 1.0)
+    return {
+        "per_class_accuracy": [round(float(a), 6) for a in per_class_acc],
+        "macro_f1": float(f1.mean()),
+    }
+
+
+def maybe_slow_metrics(config, engine, params, batches) -> dict[str, Any]:
+    """The ``use_slow_performance_metrics`` extras, or ``{}`` when the flag
+    is off — one helper for every evaluate-then-record site."""
+    if not config.use_slow_performance_metrics:
+        return {}
+    return slow_metrics_from_confusion(engine.confusion(params, batches))
 
 
 def summarize_metrics(summed: dict[str, Any]) -> dict[str, float]:

@@ -22,7 +22,7 @@ from ..models.registry import ModelContext
 from ..ops.pytree import Params
 from ..utils.logging import get_logger
 from .batching import make_epoch_batches, make_graph_batch
-from .engine import ComputeEngine, summarize_metrics
+from .engine import ComputeEngine, maybe_slow_metrics, summarize_metrics
 from .hyper_parameter import HyperParameter
 
 _PER_STEP_POINTS = (
@@ -270,5 +270,8 @@ class Inferencer(ExecutorBase):
         batches = self._epoch_batches(self.phase, shuffle_seed=None)
         summed = self.engine.evaluate(self.params, batches)
         metrics = summarize_metrics(summed)
+        metrics.update(
+            maybe_slow_metrics(self.config, self.engine, self.params, batches)
+        )
         self.performance_metric.record(len(self.performance_metric.epoch_metrics) + 1, metrics)
         return metrics

@@ -30,7 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import DistributedTrainingConfig
 from ..engine.batching import fixed_size_partition
-from ..engine.engine import ComputeEngine, summarize_metrics
+from ..engine.engine import ComputeEngine, maybe_slow_metrics, summarize_metrics
 from ..ml_type import MachineLearningPhase as Phase
 from ..utils.logging import get_logger
 from .mesh import client_slots, make_mesh
@@ -530,7 +530,13 @@ class SpmdFedAvgSession:
                 self._replicated,
             )
         summed = self.engine.evaluate(global_params, self._eval_batches)
-        return summarize_metrics(summed)
+        metric = summarize_metrics(summed)
+        metric.update(
+            maybe_slow_metrics(
+                self.config, self.engine, global_params, self._eval_batches
+            )
+        )
+        return metric
 
     def _record(
         self, round_number, metric, global_params, save_dir, extra=None
@@ -725,6 +731,9 @@ class SpmdSignSGDSession:
             )
             params, epoch_metrics = self._run_fn(params, weights, rngs)
             metric = summarize_metrics(self.engine.evaluate(params, batches))
+            metric.update(
+                maybe_slow_metrics(self.config, self.engine, params, batches)
+            )
             count = np.maximum(np.asarray(epoch_metrics["count"]), 1.0)
             self._stat[round_number] = {
                 "test_accuracy": metric["accuracy"],
@@ -737,6 +746,9 @@ class SpmdSignSGDSession:
                     np.asarray(epoch_metrics["correct"]) / count
                 ).tolist(),
             }
+            for key, value in metric.items():  # slow-metric extras
+                if key not in ("accuracy", "loss", "count"):
+                    self._stat[round_number][f"test_{key}"] = value
             get_logger().info(
                 "round: %d, sign_SGD (spmd) %d steps, test accuracy %.4f loss %.4f",
                 round_number,

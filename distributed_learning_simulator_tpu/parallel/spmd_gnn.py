@@ -358,6 +358,16 @@ class SpmdFedGNNSession:
                 metric = summarize_metrics(
                     self.engine.evaluate_single(global_params, test_batch)
                 )
+                from ..engine.engine import maybe_slow_metrics
+
+                metric.update(
+                    maybe_slow_metrics(
+                        self.config,
+                        self.engine,
+                        global_params,
+                        jax.tree.map(lambda x: x[None], test_batch),
+                    )
+                )
                 mb = self._round_payload_bytes / 1e6
                 self._stat[round_number] = {
                     "test_accuracy": metric["accuracy"],

@@ -126,3 +126,49 @@ def test_dataset_registry(name):
     dc = create_dataset_collection(config)
     assert dc.num_classes > 1
     assert dc.dataset_size(Phase.Training) > 0
+
+
+def test_slow_performance_metrics(tmp_path):
+    """use_slow_performance_metrics adds per-class accuracy + macro F1 to
+    round records on both executors (reference global.yaml key)."""
+    from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+    from distributed_learning_simulator_tpu.training import train
+
+    for executor in ("spmd", "auto"):
+        config = DistributedTrainingConfig(
+            dataset_name="MNIST",
+            model_name="LeNet5",
+            distributed_algorithm="fed_avg",
+            executor=executor,
+            worker_number=2,
+            batch_size=16,
+            round=1,
+            epoch=1,
+            use_slow_performance_metrics=True,
+            dataset_kwargs={"train_size": 64, "val_size": 16, "test_size": 48},
+            save_dir=str(tmp_path / f"slow_{executor}"),
+            log_file=str(tmp_path / f"slow_{executor}.log"),
+        )
+        stat = train(config)["performance"][1]
+        per_class = stat["test_per_class_accuracy"]
+        assert len(per_class) == 10
+        assert all(0.0 <= a <= 1.0 for a in per_class)
+        assert 0.0 <= stat["test_macro_f1"] <= 1.0
+        assert stat["test_count"] == 48.0
+        # exact aggregation: overall accuracy == class-frequency-weighted
+        # mean of per-class accuracies (confusion rows sum to class counts)
+        import numpy as np
+
+        from distributed_learning_simulator_tpu.data import (
+            create_dataset_collection,
+        )
+        from distributed_learning_simulator_tpu.ml_type import (
+            MachineLearningPhase as Phase,
+        )
+
+        test_targets = np.asarray(
+            create_dataset_collection(config).get_dataset(Phase.Test).targets
+        )
+        counts = np.bincount(test_targets, minlength=10)
+        weighted = float(np.dot(per_class, counts) / counts.sum())
+        assert abs(weighted - stat["test_accuracy"]) < 1e-4
