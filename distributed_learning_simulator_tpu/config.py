@@ -66,6 +66,10 @@ class DistributedTrainingConfig:
     save_dir: str = ""
     checkpoint_every_round: bool = True
     profile: bool = False  # capture a jax profiler trace under save_dir/profile
+    # stall watchdog for the threaded executor's message fabric: abort the
+    # task when NO message moves for this many seconds (0 = disabled; size
+    # it well above the longest per-round local training time)
+    watchdog_seconds: float = 0.0
 
     def load_config_and_process(self, overrides: dict[str, Any] | None = None) -> None:
         """Derive ``save_dir``/``log_file`` the way the reference does

@@ -29,7 +29,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..engine.batching import make_graph_batch
-from ..engine.engine import summarize_metrics
+from ..engine.engine import maybe_slow_metrics, summarize_metrics
 from ..ml_type import MachineLearningPhase as Phase
 from ..models.registry import masked_ce_loss
 from ..ops.pytree import unflatten_nested
@@ -358,8 +358,6 @@ class SpmdFedGNNSession:
                 metric = summarize_metrics(
                     self.engine.evaluate_single(global_params, test_batch)
                 )
-                from ..engine.engine import maybe_slow_metrics
-
                 metric.update(
                     maybe_slow_metrics(
                         self.config,
@@ -370,9 +368,7 @@ class SpmdFedGNNSession:
                 )
                 mb = self._round_payload_bytes / 1e6
                 self._stat[round_number] = {
-                    "test_accuracy": metric["accuracy"],
-                    "test_loss": metric["loss"],
-                    "test_count": metric["count"],
+                    **{f"test_{k}": v for k, v in metric.items()},
                     "received_mb": mb,
                     "sent_mb": mb,
                 }

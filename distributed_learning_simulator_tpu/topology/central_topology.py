@@ -48,6 +48,12 @@ class CentralTopology:
         }
         self._to_worker = {w: _Channel() for w in range(worker_num)}
         self._closed = threading.Event()
+        # monotonically-increasing message counter; the training watchdog
+        # (config.watchdog_seconds) reads it to detect a fabric-wide stall
+        self.activity = 0
+
+    def record_activity(self) -> None:
+        self.activity += 1  # racy increments still change the value
 
     def create_client_endpoint(self, worker_id: int) -> "ClientEndpoint":
         return ClientEndpoint(self, worker_id)
@@ -64,6 +70,7 @@ class ClientEndpoint:
         self.worker_id = worker_id
 
     def send(self, data: Any) -> None:
+        self._topology.record_activity()
         self._topology._to_server[self.worker_id].put(data)
 
     def get(self, timeout: float | None = None) -> Any:
@@ -113,6 +120,7 @@ class ServerEndpoint:
 
             if isinstance(data, Message):
                 self.sent_bytes += get_message_size(data)
+        self._topology.record_activity()
         self._topology._to_worker[worker_id].put(data)
 
     def broadcast(self, data: Any, worker_ids: set[int] | None = None) -> None:

@@ -157,6 +157,52 @@ def _spawn(ctx: TaskContext) -> None:
         ctx.threads.append(thread)
     for thread in ctx.threads:
         thread.start()
+    if config.watchdog_seconds > 0:
+        threading.Thread(  # not in ctx.threads: must not block harvest
+            target=_watchdog_loop,
+            args=(ctx, config.watchdog_seconds),
+            name="watchdog",
+            daemon=True,
+        ).start()
+
+
+def _watchdog_loop(ctx: TaskContext, stall_seconds: float, poll: float = 0.0) -> None:
+    """Abort the task when the message fabric makes no progress for
+    ``stall_seconds`` (SURVEY.md §5 TPU plan: "a 'deadline' watchdog on
+    collective waits") — turns a silent deadlock (an executor waiting on a
+    peer that will never send) into a raised error with a diagnosis.
+    Deliberately a *message-progress* watchdog, not a per-wait deadline:
+    long local training between messages is normal and must not trip it."""
+    import time as _time
+
+    poll = poll or min(10.0, max(0.5, stall_seconds / 10.0))
+    last_activity = ctx.topology.activity
+    stall_start = _time.monotonic()
+    while not ctx.aborted() and any(t.is_alive() for t in ctx.threads):
+        _time.sleep(poll)
+        activity = ctx.topology.activity
+        if activity != last_activity:
+            last_activity = activity
+            stall_start = _time.monotonic()
+            continue
+        stalled = _time.monotonic() - stall_start
+        if stalled > stall_seconds:
+            waiting = [t.name for t in ctx.threads if t.is_alive()]
+            get_logger().error(
+                "watchdog: no message progress for %.0fs (threshold %.0fs); "
+                "aborting task — executors still running: %s",
+                stalled,
+                stall_seconds,
+                waiting,
+            )
+            ctx.errors.append(
+                TimeoutError(
+                    f"watchdog: message fabric stalled {stalled:.0f}s; "
+                    f"live executors: {waiting}"
+                )
+            )
+            ctx.abort_event.set()
+            return
 
 
 def _remap_sv(result: dict, practitioners) -> dict:

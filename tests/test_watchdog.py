@@ -1,0 +1,121 @@
+"""Stall watchdog (``config.watchdog_seconds``): no message progress for
+the configured window aborts the task with a diagnostic instead of hanging
+forever (SURVEY.md §5 TPU plan: deadline watchdog on collective waits).
+"""
+
+import threading
+import time
+
+import pytest
+
+from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+from distributed_learning_simulator_tpu.topology.central_topology import (
+    CentralTopology,
+)
+from distributed_learning_simulator_tpu.training import (
+    TaskContext,
+    _watchdog_loop,
+    train,
+)
+
+
+def _stuck_ctx():
+    """A task whose only executor waits forever and never messages."""
+    topology = CentralTopology(1)
+    ctx = TaskContext(
+        config=None, dataset_collection=None, model_ctx=None, engine=None,
+        topology=topology, task_id=None,
+    )
+    stop = threading.Event()
+    thread = threading.Thread(target=stop.wait, daemon=True)
+    ctx.threads.append(thread)
+    thread.start()
+    return ctx, stop
+
+
+def test_watchdog_aborts_stalled_task():
+    ctx, stop = _stuck_ctx()
+    try:
+        _watchdog_loop(ctx, stall_seconds=0.3, poll=0.05)
+        assert ctx.aborted()
+        assert ctx.errors and isinstance(ctx.errors[0], TimeoutError)
+        assert "stalled" in str(ctx.errors[0])
+    finally:
+        stop.set()
+
+
+def test_watchdog_resets_on_activity():
+    ctx, stop = _stuck_ctx()
+    try:
+        ticker_stop = threading.Event()
+
+        def ticker():  # message progress keeps the watchdog quiet
+            while not ticker_stop.is_set():
+                ctx.topology.record_activity()
+                time.sleep(0.05)
+
+        threading.Thread(target=ticker, daemon=True).start()
+        watcher = threading.Thread(
+            target=_watchdog_loop, args=(ctx, 0.3, 0.05), daemon=True
+        )
+        watcher.start()
+        time.sleep(1.0)
+        assert not ctx.aborted()  # activity kept resetting the stall clock
+        ticker_stop.set()
+        watcher.join(timeout=5.0)
+        assert ctx.aborted()  # ...and silence eventually trips it
+    finally:
+        stop.set()
+
+
+def test_no_false_positive_on_normal_run(tmp_session_dir):
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        distributed_algorithm="fed_avg",
+        worker_number=2,
+        batch_size=16,
+        round=2,
+        epoch=1,
+        watchdog_seconds=30.0,
+        dataset_kwargs={"train_size": 64, "val_size": 16, "test_size": 32},
+        save_dir=str(tmp_session_dir / "wd"),
+        log_file=str(tmp_session_dir / "wd.log"),
+    )
+    result = train(config)
+    assert set(result["performance"]) == {1, 2}
+
+
+def test_stalled_training_raises(tmp_session_dir):
+    """End-to-end: a worker that never reports leaves the server waiting for
+    its all-N barrier; the watchdog turns the hang into a TimeoutError."""
+    from distributed_learning_simulator_tpu.worker.aggregation_worker import (
+        AggregationWorker,
+    )
+
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        distributed_algorithm="fed_avg",
+        worker_number=2,
+        batch_size=16,
+        round=1,
+        epoch=1,
+        watchdog_seconds=2.0,
+        dataset_kwargs={"train_size": 64, "val_size": 16, "test_size": 32},
+        save_dir=str(tmp_session_dir / "stall"),
+        log_file=str(tmp_session_dir / "stall.log"),
+    )
+    original = AggregationWorker.send_data_to_server
+
+    def mute_worker_1(self, data):
+        if self.worker_id == 1:
+            return  # swallow the upload: the server barrier never completes
+        original(self, data)
+
+    AggregationWorker.send_data_to_server = mute_worker_1
+    try:
+        with pytest.raises(TimeoutError, match="stalled"):
+            train(config)
+    finally:
+        AggregationWorker.send_data_to_server = original
