@@ -82,3 +82,52 @@ def scrape_log(path: str) -> dict:
             if m := _RATIO_RE.search(line):
                 ratios.append(float(m.group(1)))
     return {"send_nums": send_nums, "compression_ratios": ratios}
+
+
+def main(argv=None) -> None:
+    """CLI: final/mean test accuracy across the sessions under a root, plus
+    scraped send counts / compression ratios from their logs (the reference
+    script's summary surface, ``analyze_log.py:14-66``)."""
+    import argparse
+    import json
+    import os
+
+    from .session import find_sessions
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("root", help="session root (e.g. session/fed_avg)")
+    args = parser.parse_args(argv)
+    accs = []
+    summary: dict = {"sessions": []}
+    for session in find_sessions(args.root):
+        entry: dict = {"path": session.session_dir}
+        if session.last_test_acc is not None:
+            entry["last_test_acc"] = session.last_test_acc
+            accs.append(session.last_test_acc)
+        # run logs live either under <session>/log/ or at the cwd-relative
+        # path recorded in the session's config (config.py derives
+        # ``log/<save_dir with separators flattened>.log``)
+        candidates: list[str] = []
+        log_dir = os.path.join(session.session_dir, "log")
+        if os.path.isdir(log_dir):
+            candidates += [os.path.join(log_dir, n) for n in sorted(os.listdir(log_dir))]
+        config_log = (session.config or {}).get("log_file", "")
+        if config_log:
+            candidates.append(config_log)
+        scraped: dict[str, list] = {"send_nums": [], "compression_ratios": []}
+        for candidate in candidates:
+            if os.path.isfile(candidate):
+                for key, values in scrape_log(candidate).items():
+                    scraped[key].extend(values)  # merge across files
+        entry.update(scraped)
+        summary["sessions"].append(entry)
+    if accs:
+        mean = sum(accs) / len(accs)
+        std = (sum((a - mean) ** 2 for a in accs) / len(accs)) ** 0.5
+        summary["final_test_acc_mean"] = mean
+        summary["final_test_acc_std"] = std
+    print(json.dumps(summary, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,8 +18,9 @@ def collect_round_metrics(root: str) -> dict[str, dict[int, list[float]]]:
     return {k: dict(v) for k, v in table.items()}
 
 
-def plot_round_metrics(root: str, out_dir: str) -> list[str]:
-    """Write one PNG per metric if matplotlib is available."""
+def plot_round_metrics(root: str, out_dir: str, table=None) -> list[str]:
+    """Write one PNG per metric if matplotlib is available.  Pass ``table``
+    (from :func:`collect_round_metrics`) to avoid re-walking the root."""
     try:
         import matplotlib
 
@@ -29,7 +30,9 @@ def plot_round_metrics(root: str, out_dir: str) -> list[str]:
         return []
     os.makedirs(out_dir, exist_ok=True)
     written = []
-    for metric, rounds in collect_round_metrics(root).items():
+    if table is None:
+        table = collect_round_metrics(root)
+    for metric, rounds in table.items():
         xs = sorted(rounds)
         means = [sum(rounds[x]) / len(rounds[x]) for x in xs]
         fig, ax = plt.subplots()
@@ -41,3 +44,33 @@ def plot_round_metrics(root: str, out_dir: str) -> list[str]:
         plt.close(fig)
         written.append(path)
     return written
+
+
+def main(argv=None) -> None:
+    """CLI: tabulate (and optionally plot) per-round metrics across the
+    sessions under a root directory (reference usage: run as a script over
+    ``session/``)."""
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("root", help="session root (e.g. session/fed_avg)")
+    parser.add_argument("--plot-dir", default="", help="write one PNG per metric")
+    args = parser.parse_args(argv)
+    table = collect_round_metrics(args.root)
+    print(
+        json.dumps(
+            {
+                metric: {str(r): vals for r, vals in rounds.items()}
+                for metric, rounds in table.items()
+            },
+            indent=1,
+        )
+    )
+    if args.plot_dir:
+        for path in plot_round_metrics(args.root, args.plot_dir, table=table):
+            print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -99,3 +99,36 @@ def test_cost_model_and_scraper(tmp_path):
     scraped = scrape_log(str(log))
     assert scraped["send_nums"] == [123]
     assert scraped["compression_ratios"] == [0.25]
+
+
+def test_analysis_cli_mains(tmp_path, capsys):
+    """analyze_round / analyze_log run as scripts over a session root (the
+    reference's researcher workflow)."""
+    import json
+    import os
+
+    session = tmp_path / "algo" / "2026-01-01" / "uuid1"
+    os.makedirs(session / "server")
+    with open(session / "server" / "round_record.json", "wt") as f:
+        json.dump(
+            {
+                "1": {"test_accuracy": 0.5, "test_loss": 1.2},
+                "2": {"test_accuracy": 0.75, "test_loss": 0.8},
+            },
+            f,
+        )
+    from distributed_learning_simulator_tpu.analysis import analyze_log, analyze_round
+
+    analyze_round.main([str(tmp_path)])
+    table = json.loads(capsys.readouterr().out)
+    assert table["test_accuracy"]["2"] == [0.75]
+
+    analyze_log.main([str(tmp_path)])
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["final_test_acc_mean"] == 0.75
+    assert summary["sessions"][0]["path"].endswith("uuid1")
+
+    out_dir = tmp_path / "plots"
+    written = analyze_round.plot_round_metrics(str(tmp_path), str(out_dir))
+    assert written, "plotting produced no files (matplotlib is in this image)"
+    assert all(os.path.isfile(p) for p in written)
