@@ -1,0 +1,59 @@
+"""Determinism: the same config run twice produces the same results.
+
+One seed drives partitioning, selection, init, and shuffling.  The SPMD
+path is a single program with a fixed reduction order — bit-identical
+artifacts.  The threaded path accumulates in worker-ARRIVAL order (like the
+reference's streaming FedAvg, ``fed_avg_algorithm.py:19-54``) — float64
+accumulation makes the order effect vanish at float32 output precision,
+but we assert near-equality rather than bits to stay honest about it.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+from distributed_learning_simulator_tpu.training import train
+
+
+def _run(tmp_path, executor, tag):
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        distributed_algorithm="fed_avg",
+        executor=executor,
+        worker_number=3,
+        batch_size=16,
+        round=2,
+        epoch=1,
+        learning_rate=0.05,
+        dataset_kwargs={"train_size": 96, "val_size": 16, "test_size": 32},
+        save_dir=str(tmp_path / f"{executor}_{tag}"),
+        log_file=str(tmp_path / f"{executor}_{tag}.log"),
+    )
+    result = train(config)
+    params = dict(
+        np.load(tmp_path / f"{executor}_{tag}" / "aggregated_model" / "round_2.npz")
+    )
+    return result["performance"], params
+
+
+@pytest.mark.parametrize("executor", ["spmd", "auto"])
+def test_same_config_same_results(executor, tmp_session_dir):
+    stat_a, params_a = _run(tmp_session_dir, executor, "a")
+    stat_b, params_b = _run(tmp_session_dir, executor, "b")
+    assert stat_a.keys() == stat_b.keys()
+    for round_number in stat_a:
+        acc_a = stat_a[round_number]["test_accuracy"]
+        acc_b = stat_b[round_number]["test_accuracy"]
+        if executor == "spmd":
+            assert acc_a == acc_b
+        else:  # params only match to atol: allow one boundary sample flip
+            assert abs(acc_a - acc_b) <= 1.0 / 32 + 1e-12
+    assert params_a.keys() == params_b.keys()
+    for key in params_a:
+        if executor == "spmd":  # fixed reduction order: bit-identical
+            np.testing.assert_array_equal(params_a[key], params_b[key], err_msg=key)
+        else:  # arrival-order f64 accumulate: equal at output precision
+            np.testing.assert_allclose(
+                params_a[key], params_b[key], rtol=0, atol=1e-6, err_msg=key
+            )
