@@ -1,0 +1,48 @@
+"""Coverage for the smaller message/analysis components:
+``ParameterFileMessage`` (reference ``message.py:32-34``) and the
+``ModuleDiff`` drift logger (reference ``analysis/module_diff.py:8-44``).
+"""
+
+import numpy as np
+
+from distributed_learning_simulator_tpu.analysis.module_diff import ModuleDiff
+from distributed_learning_simulator_tpu.message import (
+    ParameterFileMessage,
+    ParameterMessage,
+    get_message_size,
+)
+
+
+def test_parameter_file_message_roundtrip(tmp_path):
+    params = {"dense/kernel": np.arange(6.0).reshape(2, 3), "dense/bias": np.ones(3)}
+    msg = ParameterFileMessage.dump(
+        params, str(tmp_path / "params.npz"), dataset_size=42,
+        other_data={"phase_two": True},
+    )
+    loaded = msg.load()
+    assert isinstance(loaded, ParameterMessage)
+    assert loaded.dataset_size == 42
+    assert loaded.other_data == {"phase_two": True}
+    for key, value in params.items():
+        np.testing.assert_array_equal(loaded.parameter[key], value)
+    assert get_message_size(loaded) == 6 * 8 + 3 * 8  # float64 payloads
+
+
+def test_module_diff_blocks_and_drift():
+    diff = ModuleDiff()
+    a = {
+        "conv/kernel": np.zeros((2, 2), np.float32),
+        "conv/bias": np.zeros(2, np.float32),
+        "head/kernel": np.zeros((2, 2), np.float32),
+    }
+    assert diff.observe(a) == {}  # first observation: nothing to diff
+    b = {
+        "conv/kernel": np.full((2, 2), 3.0, np.float32),  # L2 = 6
+        "conv/bias": np.zeros(2, np.float32),
+        "head/kernel": np.full((2, 2), 4.0, np.float32),  # L2 = 8
+    }
+    drifts = diff.observe(b)
+    assert set(drifts) == {"conv", "head"}  # grouped by top-level block
+    np.testing.assert_allclose(drifts["conv"], 6.0, rtol=1e-6)
+    np.testing.assert_allclose(drifts["head"], 8.0, rtol=1e-6)
+    assert diff.observe(b) == {"conv": 0.0, "head": 0.0}  # no further drift
