@@ -42,9 +42,9 @@ def _try_load_real(name: str) -> DatasetCollection | None:
     path = os.path.join(data_dir, f"{name}.npz")
     if not os.path.isfile(path):
         return None
-    blob = np.load(path)
-    x_train, y_train = blob["x_train"], blob["y_train"]
-    x_test, y_test = blob["x_test"], blob["y_test"]
+    with np.load(path) as blob:
+        x_train, y_train = blob["x_train"], blob["y_train"]
+        x_test, y_test = blob["x_test"], blob["y_test"]
     num_classes = int(y_train.max()) + 1
     n_val = max(1, len(x_test) // 2)
     return DatasetCollection(

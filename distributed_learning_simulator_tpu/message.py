@@ -70,12 +70,12 @@ class ParameterFileMessage(ParameterMessageBase):
     dataset_size: int = 0
 
     def load(self) -> ParameterMessage:
-        blob = np.load(self.path)
-        return ParameterMessage(
-            parameter={k: blob[k] for k in blob.files},
-            dataset_size=self.dataset_size,
-            other_data=self.other_data,
-        )
+        with np.load(self.path) as blob:
+            return ParameterMessage(
+                parameter={k: blob[k] for k in blob.files},
+                dataset_size=self.dataset_size,
+                other_data=self.other_data,
+            )
 
     @staticmethod
     def dump(parameter: Params, path: str, **kwargs) -> "ParameterFileMessage":

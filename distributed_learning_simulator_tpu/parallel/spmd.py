@@ -435,7 +435,8 @@ class SpmdFedAvgSession:
             rounds = [n for n in rounds if n in recorded]
             if rounds:
                 last = rounds[-1]
-                blob = np.load(os.path.join(model_dir, f"round_{last}.npz"))
+                with np.load(os.path.join(model_dir, f"round_{last}.npz")) as blob:
+                    params = {k: blob[k] for k in blob.files}
                 for key, value in recorded.items():
                     if key <= last:
                         self._stat[key] = value
@@ -443,12 +444,11 @@ class SpmdFedAvgSession:
                     s["test_accuracy"] for s in self._stat.values()
                 )
                 get_logger().info("resumed from %s round %d", resume_dir, last)
-                params = {k: blob[k] for k in blob.files}
                 return self._place_params(params), last + 1
         init_path = config.algorithm_kwargs.get("global_model_path")
         if init_path:
-            blob = np.load(init_path)
-            params = {k: blob[k] for k in blob.files}
+            with np.load(init_path) as blob:
+                params = {k: blob[k] for k in blob.files}
             return self._place_params(params), 1
         return self._place_params(self.engine.init_params(config.seed)), 1
 

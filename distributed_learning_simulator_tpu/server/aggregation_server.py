@@ -60,8 +60,8 @@ class AggregationServer(Server):
             return resumed
         init_path = self.config.algorithm_kwargs.get("global_model_path")
         if init_path:
-            blob = np.load(init_path)
-            return {k: blob[k] for k in blob.files}
+            with np.load(init_path) as blob:
+                return {k: blob[k] for k in blob.files}
         return self.tester.get_parameter_dict()
 
     def _try_resume(self) -> Params | None:
@@ -84,7 +84,8 @@ class AggregationServer(Server):
         if not rounds:
             return None
         last_round = rounds[-1]
-        blob = np.load(os.path.join(model_dir, f"round_{last_round}.npz"))
+        with np.load(os.path.join(model_dir, f"round_{last_round}.npz")) as blob:
+            resumed_params = {k: blob[k] for k in blob.files}
         record_path = os.path.join(resume_dir, "server", "round_record.json")
         if os.path.isfile(record_path):
             with open(record_path, encoding="utf8") as f:
@@ -97,7 +98,7 @@ class AggregationServer(Server):
                 self.__max_acc = restored_max
         self._round_number = last_round + 1
         get_logger().info("resumed from %s at round %d", resume_dir, self._round_number)
-        return {k: blob[k] for k in blob.files}
+        return resumed_params
 
     def _before_start(self) -> None:
         if self.config.distribute_init_parameters:

@@ -30,8 +30,8 @@ class ModelCache:
         if self._parameter_dict is None and self._path and os.path.isfile(self._path):
             import numpy as np
 
-            blob = np.load(self._path)
-            self._parameter_dict = {k: blob[k] for k in blob.files}
+            with np.load(self._path) as blob:
+                self._parameter_dict = {k: blob[k] for k in blob.files}
         return self._parameter_dict
 
     def cache_parameter_dict(self, parameter_dict: Params, path: str | None = None) -> None:
