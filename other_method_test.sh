@@ -4,5 +4,6 @@ set -e
 for algo in fed_dropout_avg fed_paq; do
   python3 ./simulator.py --config-name "$algo/cifar100.yaml" \
     ++$algo.round=1 ++$algo.epoch=1 ++$algo.worker_number=2 \
-    ++$algo.algorithm_kwargs.random_client_number=2
+    ++$algo.algorithm_kwargs.random_client_number=2 \
+    ++$algo.dataset_kwargs.train_size=512 ++$algo.dataset_kwargs.test_size=256
 done

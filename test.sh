@@ -16,7 +16,13 @@ run --config-name fed_gnn/cs.yaml \
 run --config-name gtg_sv/mnist.yaml \
   ++gtg_sv.round=1 ++gtg_sv.epoch=1 ++gtg_sv.worker_number=2
 
+# dataset bounded so the simulation-faithful executor stays CPU-friendly
+# (the reference's smoke assumed CUDA); full-size runs are the canonical
+# launchers (fed_obd_train.sh) on accelerator hardware.  NOTE: XLA:CPU
+# compiles the densenet40 train program in ~10 min (one-off per process;
+# fast on TPU) — this line is the slow one on a CPU-only host
 run --config-name fed_obd/cifar10.yaml \
   ++fed_obd.round=1 ++fed_obd.epoch=1 ++fed_obd.worker_number=10 \
   ++fed_obd.algorithm_kwargs.random_client_number=10 \
-  ++fed_obd.algorithm_kwargs.second_phase_epoch=1
+  ++fed_obd.algorithm_kwargs.second_phase_epoch=1 \
+  ++fed_obd.dataset_kwargs.train_size=640 ++fed_obd.dataset_kwargs.test_size=256
