@@ -50,15 +50,21 @@ def initialize_multihost(
 
 def put_sharded(host_data, sharding):
     """Place host arrays onto the mesh, multi-host aware: with one process
-    this is ``device_put``; on a pod each process contributes only its
-    addressable shard (``make_array_from_process_local_data`` slices the
-    per-host portion of the global batch)."""
+    this is ``device_put``; on a pod every process holds the FULL global
+    array and ``make_array_from_process_local_data`` slices out the
+    per-process portion (``global_shape == local_data.shape`` tells JAX the
+    local data is the actual target array, so each host keeps only its
+    addressable shards)."""
     if jax.process_count() == 1:
         return jax.device_put(host_data, sharding)
-    return jax.tree.map(
-        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
-        host_data,
-    )
+
+    def _place(x):
+        x = np.asarray(x)
+        return jax.make_array_from_process_local_data(
+            sharding, x, global_shape=x.shape
+        )
+
+    return jax.tree.map(_place, host_data)
 
 
 def client_slots(

@@ -33,7 +33,7 @@ from ..engine.batching import fixed_size_partition
 from ..engine.engine import ComputeEngine, maybe_slow_metrics, summarize_metrics
 from ..ml_type import MachineLearningPhase as Phase
 from ..utils.logging import get_logger
-from .mesh import client_slots, make_mesh
+from .mesh import client_slots, make_mesh, put_sharded
 
 
 def stack_client_data(config, dataset_collection, practitioners, n_slots):
@@ -195,7 +195,6 @@ class SpmdFedAvgSession:
             k: NamedSharding(self.mesh, spec)
             for k, spec in self._param_specs.items()
         }
-        from .mesh import put_sharded
 
         self._data = put_sharded(
             self._data, NamedSharding(self.mesh, self._slot_spec)
@@ -212,11 +211,10 @@ class SpmdFedAvgSession:
 
     def _place_params(self, params):
         """Place host params onto the per-leaf (possibly model-sharded)
-        layout — multi-host aware: each process contributes its addressable
-        slice (``put_sharded``), a plain device_put cannot target shards on
-        non-addressable devices."""
-        from .mesh import put_sharded
-
+        layout — multi-host aware: every process passes the FULL global
+        array and ``put_sharded`` slices out each host's addressable
+        shards; a plain device_put cannot target shards on non-addressable
+        devices."""
         return {
             k: put_sharded(v, self._param_shardings[k])
             for k, v in params.items()
@@ -479,9 +477,9 @@ class SpmdFedAvgSession:
             for round_number in range(start_round, config.round + 1):
                 start = _time.monotonic()
                 host_weights = self._select_weights(round_number)
-                weights = jax.device_put(host_weights, self._client_sharding)
+                weights = put_sharded(host_weights, self._client_sharding)
                 rng, round_rng = jax.random.split(rng)
-                client_rngs = jax.device_put(
+                client_rngs = put_sharded(
                     jax.random.split(round_rng, self.n_slots), self._client_sharding
                 )
                 # old global_params are donated into the round program —
@@ -525,7 +523,11 @@ class SpmdFedAvgSession:
             from ..engine.batching import make_epoch_batches
 
             test = self.dc.get_dataset(Phase.Test)
-            self._eval_batches = jax.device_put(
+            # put_sharded, not device_put: on a multi-host pod the replicated
+            # sharding spans non-addressable devices (every process passes
+            # the full array; JAX keeps the addressable shards), matching
+            # _place_params
+            self._eval_batches = put_sharded(
                 make_epoch_batches(test, self.config.batch_size),
                 self._replicated,
             )
@@ -571,7 +573,11 @@ class SpmdFedAvgSession:
                 dict(global_params),
             )
         # promoting the round checkpoint to best is a file copy chained on
-        # the writer queue, not a second device fetch
+        # the writer queue, not a second device fetch.  If the background
+        # save failed, copy_last_to skips the promotion while _max_acc has
+        # already advanced — until the fail-fast error surfaces at the next
+        # queue operation, best_global_model.npz may lag _max_acc by one
+        # round; a crash inside that window leaves the stale best on disk.
         if metric["accuracy"] > self._max_acc:
             self._max_acc = metric["accuracy"]
             self._ckpt.copy_last_to(
@@ -619,7 +625,6 @@ class SpmdSignSGDSession:
         self._client_sharding = NamedSharding(self.mesh, P("clients"))
         self._replicated = NamedSharding(self.mesh, P())
         # scan wants batch-major: [n_batches, C, B, ...]
-        from .mesh import put_sharded
 
         self._data = put_sharded(
             {k: np.swapaxes(v, 0, 1) for k, v in self._data.items()},
@@ -705,11 +710,14 @@ class SpmdSignSGDSession:
         return fn
 
     def run(self) -> dict:
+
         config = self.config
-        params = jax.device_put(
+        # put_sharded throughout: multi-host pods need per-process shard
+        # placement (see _place_params in SpmdFedAvgSession)
+        params = put_sharded(
             self.engine.init_params(config.seed), self._replicated
         )
-        weights = jax.device_put(
+        weights = put_sharded(
             (self._dataset_sizes > 0).astype(np.float32), self._client_sharding
         )
         save_dir = os.path.join(config.save_dir, "server")
@@ -718,12 +726,12 @@ class SpmdSignSGDSession:
 
         test = self.dc.get_dataset(Phase.Test)
         # device-resident once, not re-uploaded per round
-        batches = jax.device_put(
+        batches = put_sharded(
             make_epoch_batches(test, config.batch_size), self._replicated
         )
         best_acc = -1.0
         for round_number in range(1, config.round + 1):
-            rngs = jax.device_put(
+            rngs = put_sharded(
                 jax.random.split(
                     jax.random.PRNGKey(config.seed + round_number), self.n_slots
                 ),

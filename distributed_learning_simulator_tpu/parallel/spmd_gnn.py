@@ -34,7 +34,7 @@ from ..ml_type import MachineLearningPhase as Phase
 from ..models.registry import masked_ce_loss
 from ..ops.pytree import unflatten_nested
 from ..utils.logging import get_logger
-from .mesh import client_slots, make_mesh
+from .mesh import client_slots, make_mesh, put_sharded
 from .spmd import shard_map_compat
 
 
@@ -146,7 +146,6 @@ class SpmdFedGNNSession:
         replicated = NamedSharding(self.mesh, P())
         self._client_sharding = client_sharding
         self._replicated = replicated
-        from .mesh import put_sharded
 
         self._data = {
             "local_edges": put_sharded(local_edges, client_sharding),
@@ -154,9 +153,9 @@ class SpmdFedGNNSession:
             "provide": put_sharded(provide_mask, client_sharding),
             "recv": put_sharded(recv_mask, client_sharding),
             "train_mask": put_sharded(train_mask, client_sharding),
-            "x": jax.device_put(np.asarray(graph["x"], np.float32), replicated),
-            "edge_index": jax.device_put(edge_index, replicated),
-            "targets": jax.device_put(
+            "x": put_sharded(np.asarray(graph["x"], np.float32), replicated),
+            "edge_index": put_sharded(edge_index, replicated),
+            "targets": put_sharded(
                 np.asarray(train.targets, np.int32), replicated
             ),
         }
@@ -327,10 +326,10 @@ class SpmdFedGNNSession:
         config = self.config
         save_dir = os.path.join(config.save_dir, "server")
         os.makedirs(save_dir, exist_ok=True)
-        global_params = jax.device_put(
+        global_params = put_sharded(
             self.engine.init_params(config.seed), self._replicated
         )
-        weights = jax.device_put(
+        weights = put_sharded(
             self._dataset_sizes, self._client_sharding
         )
         rng = jax.random.PRNGKey(config.seed)
@@ -341,7 +340,7 @@ class SpmdFedGNNSession:
             for round_number in range(1, config.round + 1):
                 self._before_round(round_number)
                 rng, round_rng = jax.random.split(rng)
-                client_rngs = jax.device_put(
+                client_rngs = put_sharded(
                     jax.random.split(round_rng, self.n_slots), self._client_sharding
                 )
                 # old global_params are donated into the round program —
@@ -433,6 +432,6 @@ class SpmdFedAASSession(SpmdFedGNNSession):
                 self.config.seed * 1013 + c * 97 + round_number
             )
             resampled[c] = cap_fan_in(self._base_local[c], self._dst, limit, rng)
-        masks = jax.device_put(resampled, self._client_sharding)
+        masks = put_sharded(resampled, self._client_sharding)
         self._data["local_edges"] = masks
         self._data["cross_edges"] = masks

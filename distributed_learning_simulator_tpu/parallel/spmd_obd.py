@@ -40,6 +40,7 @@ import numpy as np
 from ..method.fed_obd.obd_algorithm import get_module_blocks
 from ..ops.quantization import nnadq_quantize_dequantize
 from ..utils.logging import get_logger
+from .mesh import put_sharded
 from .spmd import SpmdFedAvgSession, scan_local_epochs, shard_map_compat
 from jax.sharding import PartitionSpec as P
 
@@ -285,7 +286,7 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         os.makedirs(save_dir, exist_ok=True)
         early_stop = bool(config.algorithm_kwargs.get("early_stop", False))
         second_phase_epoch = int(config.algorithm_kwargs["second_phase_epoch"])
-        train_params = jax.device_put(
+        train_params = put_sharded(
             self.engine.init_params(config.seed), self._replicated
         )
         rng = jax.random.PRNGKey(config.seed)
@@ -293,10 +294,10 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         def step(fn, params, weights):
             nonlocal rng
             rng, round_rng, bcast_rng = jax.random.split(rng, 3)
-            client_rngs = jax.device_put(
+            client_rngs = put_sharded(
                 jax.random.split(round_rng, self.n_slots), self._client_sharding
             )
-            weights = jax.device_put(weights, self._client_sharding)
+            weights = put_sharded(weights, self._client_sharding)
             exact, bcast, metrics = fn(params, weights, client_rngs, bcast_rng)
             return exact, bcast, {
                 k: float(np.asarray(v)) for k, v in metrics.items()

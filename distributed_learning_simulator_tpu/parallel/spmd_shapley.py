@@ -26,6 +26,7 @@ from jax.sharding import PartitionSpec as P
 from ..engine.batching import make_epoch_batches
 from ..ml_type import MachineLearningPhase as Phase
 from ..utils.logging import get_logger
+from .mesh import put_sharded
 from .spmd import SpmdFedAvgSession, scan_local_epochs, shard_map_compat
 
 ENGINE_FOR = {
@@ -45,7 +46,7 @@ class SpmdShapleySession(SpmdFedAvgSession):
         self._sv_engine = None
         self.shapley_values: dict[int, dict] = {}
         self.shapley_values_S: dict[int, dict] = {}
-        self._eval_batches = jax.device_put(
+        self._eval_batches = put_sharded(
             make_epoch_batches(
                 self.dc.get_dataset(Phase.Test), self.config.batch_size
             ),
@@ -136,7 +137,7 @@ class SpmdShapleySession(SpmdFedAvgSession):
         config = self.config
         save_dir = os.path.join(config.save_dir, "server")
         os.makedirs(save_dir, exist_ok=True)
-        global_params = jax.device_put(
+        global_params = put_sharded(
             self.engine.init_params(config.seed), self._replicated
         )
         # need_init_performance: round-0 metric seeds the SV engine
@@ -163,11 +164,11 @@ class SpmdShapleySession(SpmdFedAvgSession):
 
     def _run_rounds(self, config, global_params, rng, choose_best, save_dir):
         for round_number in range(1, config.round + 1):
-            weights = jax.device_put(
+            weights = put_sharded(
                 self._select_weights(round_number), self._client_sharding
             )
             rng, round_rng = jax.random.split(rng)
-            client_rngs = jax.device_put(
+            client_rngs = put_sharded(
                 jax.random.split(round_rng, self.n_slots), self._client_sharding
             )
             params_s, _ = self._round_fn(global_params, weights, client_rngs)

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .mesh import put_sharded
 from .spmd import SpmdFedAvgSession, scan_local_epochs, shard_map_compat
 
 
@@ -115,7 +116,7 @@ class SpmdSMAFDSession(SpmdFedAvgSession):
             lambda p: np.zeros((self.n_slots, *p.shape), np.float32),
             self.engine.init_params(self.config.seed),
         )
-        self._err_state = jax.device_put(
+        self._err_state = put_sharded(
             err0, NamedSharding(self.mesh, P("clients"))
         )
 

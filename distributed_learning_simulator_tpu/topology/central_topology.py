@@ -74,7 +74,10 @@ class ClientEndpoint:
         self._topology._to_server[self.worker_id].put(data)
 
     def get(self, timeout: float | None = None) -> Any:
-        return self._topology._to_worker[self.worker_id].get(timeout=timeout)
+        data = self._topology._to_worker[self.worker_id].get(timeout=timeout)
+        if data is not None:
+            self._topology.record_activity()
+        return data
 
     def has_data(self) -> bool:
         return self._topology._to_worker[self.worker_id].has_data()
@@ -112,6 +115,9 @@ class ServerEndpoint:
 
             if isinstance(data, Message):
                 self.received_bytes += get_message_size(data)
+            # drains count as progress too: a pull-only phase (no send)
+            # must not trip the stall watchdog
+            self._topology.record_activity()
         return data
 
     def send(self, worker_id: int, data: Any) -> None:

@@ -145,5 +145,15 @@ class AsyncCheckpointWriter:
         else:
             try:
                 self.wait()
-            except Exception:
-                pass
+            except (KeyboardInterrupt, SystemExit):
+                raise  # a Ctrl-C during the drain is not a checkpoint error
+            except BaseException as ckpt_err:  # the worker stores BaseException
+                # the run is already unwinding from another error — don't
+                # mask it, but leave a trace of the lost checkpoint write
+                from ..utils.logging import get_logger
+
+                get_logger().warning(
+                    "background checkpoint write failed during error "
+                    "unwind (suppressed): %s",
+                    ckpt_err,
+                )
