@@ -12,7 +12,6 @@ data is present on disk (``$DLS_TPU_DATA_DIR/<name>.npz`` with ``x_train``,
 """
 
 import hashlib
-import os
 from collections.abc import Callable
 
 import numpy as np
@@ -35,32 +34,12 @@ def _seed_for(name: str) -> int:
     return int.from_bytes(hashlib.sha256(name.encode()).digest()[:4], "little")
 
 
-def _try_load_real(name: str) -> DatasetCollection | None:
-    data_dir = os.environ.get("DLS_TPU_DATA_DIR", "")
-    if not data_dir:
-        return None
-    path = os.path.join(data_dir, f"{name}.npz")
-    if not os.path.isfile(path):
-        return None
-    with np.load(path) as blob:
-        x_train, y_train = blob["x_train"], blob["y_train"]
-        x_test, y_test = blob["x_test"], blob["y_test"]
-    num_classes = int(y_train.max()) + 1
-    n_val = max(1, len(x_test) // 2)
-    return DatasetCollection(
-        name=name,
-        datasets={
-            Phase.Training: ArrayDataset(x_train.astype(np.float32), y_train.astype(np.int32)),
-            Phase.Validation: ArrayDataset(
-                x_test[:n_val].astype(np.float32), y_test[:n_val].astype(np.int32)
-            ),
-            Phase.Test: ArrayDataset(
-                x_test[n_val:].astype(np.float32), y_test[n_val:].astype(np.int32)
-            ),
-        },
-        num_classes=num_classes,
-        input_shape=tuple(x_train.shape[1:]),
-    )
+def _try_load_real(name: str, **kwargs) -> DatasetCollection | None:
+    """Real data from ``$DLS_TPU_DATA_DIR/<name>.npz`` (see ``data/real.py``
+    for the schema and ``tools/ingest_data.py`` for producing it)."""
+    from .real import load_real_collection
+
+    return load_real_collection(name, **kwargs)
 
 
 def _synthetic_vision(
@@ -181,6 +160,9 @@ def _text_factory(name: str, num_classes: int, default_train: int):
         tokenizer: dict | None = None,
         **_: object,
     ) -> DatasetCollection:
+        real = _try_load_real(name, max_len=max_len)
+        if real is not None:
+            return real
         val_size_ = val_size or max(256, train_size // 8)
         test_size_ = test_size or max(512, train_size // 4)
         return _synthetic_text(
@@ -262,6 +244,9 @@ def _graph_factory(name: str, num_nodes: int, num_features: int, num_classes: in
     def factory(
         num_nodes_: int = 0, num_features_: int = 0, **_: object
     ) -> DatasetCollection:
+        real = _try_load_real(name)
+        if real is not None:
+            return real
         return _synthetic_graph(
             name, num_nodes_ or num_nodes, num_features_ or num_features, num_classes
         )

@@ -1,0 +1,72 @@
+"""Deterministic vocab-file tokenizer.
+
+The reference tokenizes IMDB with spacy (``conf/fed_avg/imdb.yaml:16-18``,
+``dataset_kwargs.tokenizer.type: spacy``); that requires a model download,
+so this build uses a deterministic regex word tokenizer — the SAME one
+``tools/ingest_data.py`` used to build the dataset, guaranteeing train-time
+and inference-time token ids agree.  The vocab rides in the dataset npz
+(``vocab`` key) or any one-word-per-line text file.
+"""
+
+import re
+
+import numpy as np
+
+_WORD_RE = re.compile(r"[a-z0-9']+")
+
+PAD_ID = 0
+UNK_ID = 1
+N_SPECIALS = 2
+
+
+def tokenize(text: str) -> list[str]:
+    return _WORD_RE.findall(text.lower().replace("<br />", " "))
+
+
+class VocabTokenizer:
+    """text → fixed-length int32 id rows, pad=0/unk=1, deterministic."""
+
+    def __init__(self, vocab: list[str], max_len: int = 300) -> None:
+        self.vocab = list(vocab)
+        self.max_len = int(max_len)
+        self._index = {w: i + N_SPECIALS for i, w in enumerate(self.vocab)}
+
+    @classmethod
+    def from_file(cls, path: str, max_len: int = 300) -> "VocabTokenizer":
+        with open(path, encoding="utf8") as f:
+            vocab = [line.strip() for line in f if line.strip()]
+        return cls(vocab, max_len)
+
+    @classmethod
+    def from_dataset(cls, dataset_collection) -> "VocabTokenizer":
+        meta = dataset_collection.metadata
+        if not meta.get("vocab"):
+            raise ValueError(
+                f"dataset {dataset_collection.name!r} carries no vocab "
+                "(synthetic datasets have none; ingest real data first)"
+            )
+        return cls(meta["vocab"], meta.get("max_len", 300))
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab) + N_SPECIALS
+
+    def encode(self, text: str) -> np.ndarray:
+        ids = [self._index.get(t, UNK_ID) for t in tokenize(text)[: self.max_len]]
+        out = np.full(self.max_len, PAD_ID, np.int32)
+        out[: len(ids)] = ids
+        return out
+
+    def encode_batch(self, texts: list[str]) -> np.ndarray:
+        return np.stack([self.encode(t) for t in texts])
+
+    def decode(self, ids) -> list[str]:
+        words = []
+        for token_id in np.asarray(ids).tolist():
+            if token_id == PAD_ID:
+                continue
+            if token_id == UNK_ID:
+                words.append("<unk>")
+            elif 0 <= token_id - N_SPECIALS < len(self.vocab):
+                words.append(self.vocab[token_id - N_SPECIALS])
+        return words

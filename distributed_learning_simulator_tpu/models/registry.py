@@ -43,11 +43,17 @@ class ModelContext:
     loss_type: str = "softmax_ce"
     compute_dtype: Any = jnp.float32
     aux_loss_weight: float = 0.01  # Switch-style router balance weight
+    # post-init param transform (e.g. seed the embed table from ingested
+    # GloVe vectors — reference: word_vector_name, conf/fed_avg/imdb.yaml:14)
+    param_override: Any = None
 
     def init(self, rng: jax.Array) -> Params:
         example = jax.tree.map(jnp.asarray, self.example_input)
         variables = self.module.init(rng, example, train=False)
-        return flatten_nested(variables["params"])
+        params = flatten_nested(variables["params"])
+        if self.param_override is not None:
+            params = self.param_override(params)
+        return params
 
     def apply(
         self, params: Params, inputs, train: bool = False, rngs=None, mutable=False

@@ -2,8 +2,11 @@
 
 ``TransformerClassificationModel`` mirrors the reference's IMDB classifier
 (``conf/fed_avg/imdb.yaml``: d_model=100, nhead=5, num_encoder_layer=2,
-max_len=300, GloVe word vectors).  With zero egress there are no pretrained
-GloVe vectors; embeddings are learned from scratch (same shape).
+max_len=300, GloVe word vectors).  When ``word_vector_name`` is set and the
+ingested GloVe npz + dataset vocab are present under ``$DLS_TPU_DATA_DIR``
+(``tools/ingest_data.py glove``), the embed table is initialized from the
+pretrained vectors; otherwise embeddings are learned from scratch (same
+shape — zero egress means no download path).
 """
 
 import flax.linen as nn
@@ -118,10 +121,21 @@ def _transformer(
         max_len=max_len or meta.get("max_len", 300),
         pad_id=meta.get("pad_id", 0),
     )
+    # pretrained embedding init when both the ingested vectors and the
+    # dataset's vocab are on disk (reference: word_vector_name, torchtext
+    # GloVe download at conf/fed_avg/imdb.yaml:14)
+    param_override = None
+    if word_vector_name and meta.get("vocab"):
+        from ..data.real import glove_embedding_override
+
+        param_override = glove_embedding_override(
+            word_vector_name, meta["vocab"], "Embed_0/embedding"
+        )
     return ModelContext(
         name="TransformerClassificationModel",
         module=module,
         example_input=example_batch(dataset_collection),
         num_classes=dataset_collection.num_classes,
         dataset_type="text",
+        param_override=param_override,
     )

@@ -1,0 +1,300 @@
+"""Real-data path: fake standard-distribution files → tools/ingest_data.py
+→ ``$DLS_TPU_DATA_DIR/<name>.npz`` → registry real branch → training.
+
+The reference consumes real MNIST/CIFAR/IMDB/planetoid through the
+``cyy_torch_*`` registries (``common_import.py:1-2``); here the same names
+resolve to ingested npz files when present (VERDICT round 1, item 1)."""
+
+import gzip
+import os
+import pickle
+import struct
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"),
+)
+import ingest_data  # noqa: E402
+
+from distributed_learning_simulator_tpu.data.registry import (  # noqa: E402
+    global_dataset_factory,
+)
+from distributed_learning_simulator_tpu.ml_type import (  # noqa: E402
+    MachineLearningPhase as Phase,
+)
+
+
+def write_idx_images(path: str, images: np.ndarray, compress: bool = False):
+    header = struct.pack(">HBB", 0, 0x08, 3) + struct.pack(
+        ">3I", images.shape[0], images.shape[1], images.shape[2]
+    )
+    opener = gzip.open if compress else open
+    with opener(path, "wb") as f:
+        f.write(header + images.astype(np.uint8).tobytes())
+
+
+def write_idx_labels(path: str, labels: np.ndarray, compress: bool = False):
+    header = struct.pack(">HBB", 0, 0x08, 1) + struct.pack(">I", labels.shape[0])
+    opener = gzip.open if compress else open
+    with opener(path, "wb") as f:
+        f.write(header + labels.astype(np.uint8).tobytes())
+
+
+@pytest.fixture()
+def data_dir(tmp_path, monkeypatch):
+    out = tmp_path / "ingested"
+    out.mkdir()
+    monkeypatch.setenv("DLS_TPU_DATA_DIR", str(out))
+    return tmp_path
+
+
+def test_mnist_idx_roundtrip(data_dir):
+    rng = np.random.default_rng(0)
+    raw = data_dir / "mnist_raw"
+    raw.mkdir()
+    train_x = rng.integers(0, 256, size=(32, 28, 28), dtype=np.uint8)
+    train_y = rng.integers(0, 10, size=32).astype(np.uint8)
+    test_x = rng.integers(0, 256, size=(16, 28, 28), dtype=np.uint8)
+    test_y = rng.integers(0, 10, size=16).astype(np.uint8)
+    # gzip on train, raw on test: both spellings must resolve
+    write_idx_images(str(raw / "train-images-idx3-ubyte.gz"), train_x, compress=True)
+    write_idx_labels(str(raw / "train-labels-idx1-ubyte.gz"), train_y, compress=True)
+    write_idx_images(str(raw / "t10k-images-idx3-ubyte"), test_x)
+    write_idx_labels(str(raw / "t10k-labels-idx1-ubyte"), test_y)
+
+    ingest_data.ingest_mnist(str(raw), os.environ["DLS_TPU_DATA_DIR"])
+
+    dc = global_dataset_factory["MNIST"]()
+    assert dc.metadata.get("real") is True
+    train = dc.get_dataset(Phase.Training)
+    assert train.inputs.shape == (32, 28, 28, 1)
+    assert train.inputs.dtype == np.float32
+    assert np.array_equal(train.targets, train_y.astype(np.int32))
+    # normalization applied: roughly zero-mean over the train split
+    assert abs(float(train.inputs.mean())) < 0.1
+    # val/test split the 16 test rows
+    assert dc.dataset_size(Phase.Validation) + dc.dataset_size(Phase.Test) == 16
+
+
+def test_cifar10_pickle_roundtrip(data_dir):
+    rng = np.random.default_rng(1)
+    raw = data_dir / "cifar-10-batches-py"
+    raw.mkdir()
+    for i in range(1, 6):
+        batch = {
+            b"data": rng.integers(0, 256, size=(8, 3072), dtype=np.uint8),
+            b"labels": rng.integers(0, 10, size=8).tolist(),
+        }
+        with open(raw / f"data_batch_{i}", "wb") as f:
+            pickle.dump(batch, f)
+    test = {
+        b"data": rng.integers(0, 256, size=(8, 3072), dtype=np.uint8),
+        b"labels": rng.integers(0, 10, size=8).tolist(),
+    }
+    with open(raw / "test_batch", "wb") as f:
+        pickle.dump(test, f)
+
+    ingest_data.ingest_cifar10(str(raw), os.environ["DLS_TPU_DATA_DIR"])
+
+    dc = global_dataset_factory["CIFAR10"]()
+    assert dc.metadata.get("real") is True
+    train = dc.get_dataset(Phase.Training)
+    assert train.inputs.shape == (40, 32, 32, 3)
+    # HWC layout: channel dim last (ingest transposes the CHW pickle rows)
+    first = test[b"data"][0].reshape(3, 32, 32).transpose(1, 2, 0)
+    with np.load(
+        os.path.join(os.environ["DLS_TPU_DATA_DIR"], "CIFAR10.npz")
+    ) as blob:
+        assert np.array_equal(blob["x_test"][0], first)
+
+
+def test_imdb_text_roundtrip(data_dir):
+    raw = data_dir / "aclImdb"
+    reviews = {
+        "pos": ["a great movie , truly great", "wonderful film<br />loved it"],
+        "neg": ["terrible boring movie", "awful . just awful and boring"],
+    }
+    for split in ("train", "test"):
+        for sub, texts in reviews.items():
+            d = raw / split / sub
+            d.mkdir(parents=True)
+            for i, text in enumerate(texts):
+                (d / f"{i}_7.txt").write_text(text, encoding="utf8")
+
+    ingest_data.ingest_imdb(
+        str(raw), os.environ["DLS_TPU_DATA_DIR"], max_len=12, vocab_size=50
+    )
+
+    dc = global_dataset_factory["imdb"](max_len=12)
+    assert dc.metadata.get("real") is True
+    assert dc.dataset_type == "text"
+    train = dc.get_dataset(Phase.Training)
+    assert train.inputs.shape == (4, 12)
+    assert train.inputs.dtype == np.int32
+    # pos label = 1, neg = 0; the two pos reviews come first
+    assert train.targets.tolist() == [1, 1, 0, 0]
+    # 'great' appears 3x in train -> must be in vocab, same id both splits
+    vocab = dc.metadata["vocab"]
+    assert "great" in vocab
+    gid = vocab.index("great") + ingest_data._N_SPECIALS
+    assert gid in train.inputs[0]
+    # config-side max_len re-fit works (truncate stored 12 -> 8)
+    dc8 = global_dataset_factory["imdb"](max_len=8)
+    assert dc8.get_dataset(Phase.Training).inputs.shape == (4, 8)
+    # the IMDB config alias resolves the same ingested imdb.npz
+    assert global_dataset_factory["IMDB"](max_len=12).metadata.get("real") is True
+
+
+def test_planetoid_graph_roundtrip(data_dir):
+    pytest.importorskip("scipy")
+    import scipy.sparse as sp
+
+    rng = np.random.default_rng(2)
+    raw = data_dir / "planetoid"
+    raw.mkdir()
+    n_labeled, n_unlabeled, n_test, n_feat, n_cls = 6, 10, 4, 8, 3
+    n_allx = n_labeled + n_unlabeled
+    num_nodes = n_allx + n_test
+
+    def onehot(labels):
+        eye = np.eye(n_cls, dtype=np.float32)
+        return eye[labels]
+
+    allx = sp.csr_matrix(rng.normal(size=(n_allx, n_feat)).astype(np.float32))
+    tx = sp.csr_matrix(rng.normal(size=(n_test, n_feat)).astype(np.float32))
+    ally = onehot(rng.integers(0, n_cls, size=n_allx))
+    ty = onehot(rng.integers(0, n_cls, size=n_test))
+    y = ally[:n_labeled]
+    graph = {
+        node: [int(neighbor) for neighbor in rng.integers(0, num_nodes, size=3)]
+        for node in range(num_nodes)
+    }
+    parts = {
+        "x": sp.csr_matrix(allx[:n_labeled]),
+        "tx": tx,
+        "allx": allx,
+        "y": y,
+        "ty": ty,
+        "ally": ally,
+        "graph": graph,
+    }
+    for part, obj in parts.items():
+        with open(raw / f"ind.cora.{part}", "wb") as f:
+            pickle.dump(obj, f)
+    test_idx = np.arange(n_allx, num_nodes)
+    np.savetxt(raw / "ind.cora.test.index", test_idx, fmt="%d")
+
+    ingest_data.ingest_planetoid(
+        str(raw), os.environ["DLS_TPU_DATA_DIR"], name="cora"
+    )
+
+    dc = global_dataset_factory["Cora"]()
+    assert dc.metadata.get("real") is True
+    assert dc.dataset_type == "graph"
+    train = dc.get_dataset(Phase.Training)
+    assert train.inputs["x"].shape == (num_nodes, n_feat)
+    assert train.inputs["mask"].sum() == n_labeled
+    assert dc.get_dataset(Phase.Test).inputs["mask"].sum() == n_test
+    # symmetrized edges
+    edges = train.inputs["edge_index"]
+    pairs = set(map(tuple, edges.T.tolist()))
+    assert all((b, a) in pairs for a, b in pairs)
+
+
+def test_glove_embedding_init_and_tokenizer(data_dir):
+    # ingest a toy imdb + toy glove file whose dim matches d_model
+    raw = data_dir / "aclImdb"
+    for split in ("train", "test"):
+        for sub, text in (("pos", "great great movie"), ("neg", "awful movie")):
+            d = raw / split / sub
+            d.mkdir(parents=True)
+            (d / "0_1.txt").write_text(text, encoding="utf8")
+    ingest_data.ingest_imdb(
+        str(raw), os.environ["DLS_TPU_DATA_DIR"], max_len=8, vocab_size=10
+    )
+    d_model = 20
+    glove_txt = data_dir / "glove.6B.20d.txt"
+    rng = np.random.default_rng(7)
+    lines = [
+        " ".join(["great"] + [f"{v:.4f}" for v in rng.normal(size=d_model)]),
+        " ".join(["movie"] + [f"{v:.4f}" for v in rng.normal(size=d_model)]),
+        " ".join(["unrelated"] + [f"{v:.4f}" for v in rng.normal(size=d_model)]),
+    ]
+    glove_txt.write_text("\n".join(lines), encoding="utf8")
+    ingest_data.ingest_glove(str(glove_txt), os.environ["DLS_TPU_DATA_DIR"])
+
+    import jax
+
+    from distributed_learning_simulator_tpu.data.tokenizer import VocabTokenizer
+    from distributed_learning_simulator_tpu.models.registry import (
+        create_model_context,
+    )
+
+    dc = global_dataset_factory["imdb"](max_len=8)
+    ctx = create_model_context(
+        "TransformerClassificationModel",
+        dc,
+        d_model=d_model,
+        nhead=4,
+        num_encoder_layer=1,
+        word_vector_name="glove.6B.20d",
+    )
+    assert ctx.param_override is not None
+    params = ctx.init(jax.random.PRNGKey(0))
+    table = np.asarray(params["Embed_0/embedding"])
+
+    tok = VocabTokenizer.from_dataset(dc)
+    with np.load(
+        os.path.join(os.environ["DLS_TPU_DATA_DIR"], "glove.20d.npz")
+    ) as blob:
+        glove_words = [str(w) for w in blob["words"]]
+        glove_vectors = blob["vectors"]
+    gid = tok.encode("great")[0]
+    np.testing.assert_allclose(
+        table[gid], glove_vectors[glove_words.index("great")], rtol=1e-6
+    )
+    # tokenizer round-trips against the ingested ids
+    train = dc.get_dataset(Phase.Training)
+    np.testing.assert_array_equal(tok.encode("great great movie"), train.inputs[0])
+    assert tok.decode(train.inputs[0]) == ["great", "great", "movie"]
+
+
+def test_training_on_real_npz(data_dir, tmp_path, monkeypatch):
+    """The full e2e claim: fed_avg/mnist trains on the ingested npz."""
+    rng = np.random.default_rng(3)
+    raw = data_dir / "mnist_raw"
+    raw.mkdir()
+    # separable fake digits: class-dependent brightness
+    labels = np.tile(np.arange(10), 20).astype(np.uint8)
+    images = (labels[:, None, None] * 25 + rng.integers(0, 10, (200, 28, 28))).astype(
+        np.uint8
+    )
+    write_idx_images(str(raw / "train-images-idx3-ubyte"), images)
+    write_idx_labels(str(raw / "train-labels-idx1-ubyte"), labels)
+    write_idx_images(str(raw / "t10k-images-idx3-ubyte"), images[:40])
+    write_idx_labels(str(raw / "t10k-labels-idx1-ubyte"), labels[:40])
+    ingest_data.ingest_mnist(str(raw), os.environ["DLS_TPU_DATA_DIR"])
+
+    monkeypatch.chdir(tmp_path)
+    from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+    from distributed_learning_simulator_tpu.training import train
+
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        distributed_algorithm="fed_avg",
+        optimizer_name="SGD",
+        worker_number=2,
+        batch_size=16,
+        round=1,
+        epoch=1,
+        learning_rate=0.05,
+    )
+    result = train(config)
+    stat = result["performance"]
+    assert len(stat) == 1
+    assert 0.0 <= next(iter(stat.values()))["test_accuracy"] <= 1.0

@@ -1,0 +1,404 @@
+#!/usr/bin/env python3
+"""Convert locally-downloaded standard dataset distributions into the
+``$DLS_TPU_DATA_DIR/<name>.npz`` schema consumed by
+``distributed_learning_simulator_tpu.data.real`` (see that module's
+docstring for the exact key layout).
+
+The reference pulls these datasets through ``cyy_torch_vision`` /
+``cyy_torch_text`` / ``cyy_torch_graph`` downloads
+(``/root/reference/simulation_lib/method/common_import.py:1-2``); this
+build is zero-egress, so ingestion is an explicit offline step over the
+standard distribution formats:
+
+    # MNIST / FashionMNIST: idx files (optionally .gz), as distributed
+    python tools/ingest_data.py mnist --src ~/mnist_raw --out $DLS_TPU_DATA_DIR
+    python tools/ingest_data.py fashionmnist --src ~/fmnist_raw --out $DLS_TPU_DATA_DIR
+
+    # CIFAR: the python pickle batches (cifar-10-batches-py / cifar-100-python)
+    python tools/ingest_data.py cifar10 --src ~/cifar-10-batches-py --out $DLS_TPU_DATA_DIR
+    python tools/ingest_data.py cifar100 --src ~/cifar-100-python --out $DLS_TPU_DATA_DIR
+
+    # IMDB: the aclImdb directory tree (train/{pos,neg}, test/{pos,neg})
+    python tools/ingest_data.py imdb --src ~/aclImdb --out $DLS_TPU_DATA_DIR
+
+    # Planetoid citation graphs: the ind.<name>.* pickles
+    python tools/ingest_data.py planetoid --name cora --src ~/planetoid/data --out $DLS_TPU_DATA_DIR
+
+    # GloVe word vectors: glove.6B.100d.txt -> glove.100d.npz (embedding init)
+    python tools/ingest_data.py glove --src ~/glove.6B.100d.txt --out $DLS_TPU_DATA_DIR
+
+Every converter writes a single compressed npz whose ``kind`` key selects
+the loader schema (vision / text / graph).
+"""
+
+import argparse
+import glob
+import gzip
+import os
+import pickle
+import struct
+import sys
+
+import numpy as np
+
+
+def _open_maybe_gz(path: str):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def _find(src: str, *candidates: str) -> str:
+    for cand in candidates:
+        for suffix in ("", ".gz"):
+            path = os.path.join(src, cand + suffix)
+            if os.path.isfile(path):
+                return path
+    raise FileNotFoundError(f"none of {candidates} (.gz ok) under {src}")
+
+
+def read_idx(path: str) -> np.ndarray:
+    """MNIST idx format: magic(2 zero bytes, dtype byte, ndim byte) then
+    big-endian int32 dims, then raw data."""
+    with _open_maybe_gz(path) as f:
+        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+        if zero != 0:
+            raise ValueError(f"{path}: bad idx magic")
+        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        dtype = {
+            0x08: np.uint8,
+            0x09: np.int8,
+            0x0B: np.dtype(">i2"),
+            0x0C: np.dtype(">i4"),
+            0x0D: np.dtype(">f4"),
+            0x0E: np.dtype(">f8"),
+        }[dtype_code]
+        data = np.frombuffer(f.read(), dtype=dtype)
+    return data.reshape(dims)
+
+
+def _channel_stats(x_train: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    scaled = x_train.astype(np.float32) / 255.0
+    axes = tuple(range(scaled.ndim - 1))
+    return scaled.mean(axis=axes), scaled.std(axis=axes) + 1e-7
+
+
+def _write(out_dir: str, name: str, **arrays) -> str:
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"{name}.npz")
+    np.savez_compressed(path, **arrays)
+    sizes = {k: getattr(v, "shape", v) for k, v in arrays.items() if k != "kind"}
+    print(f"wrote {path}: {sizes}")
+    return path
+
+
+def ingest_mnist(src: str, out: str, name: str = "MNIST") -> str:
+    x_train = read_idx(_find(src, "train-images-idx3-ubyte", "train-images.idx3-ubyte"))
+    y_train = read_idx(_find(src, "train-labels-idx1-ubyte", "train-labels.idx1-ubyte"))
+    x_test = read_idx(_find(src, "t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"))
+    y_test = read_idx(_find(src, "t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"))
+    x_train = x_train.reshape(-1, 28, 28, 1)
+    x_test = x_test.reshape(-1, 28, 28, 1)
+    mean, std = _channel_stats(x_train)
+    return _write(
+        out,
+        name,
+        kind="vision",
+        x_train=x_train.astype(np.uint8),
+        y_train=y_train.astype(np.int32),
+        x_test=x_test.astype(np.uint8),
+        y_test=y_test.astype(np.int32),
+        mean=mean,
+        std=std,
+    )
+
+
+def _unpickle(path: str) -> dict:
+    with open(path, "rb") as f:
+        return pickle.load(f, encoding="bytes")
+
+
+def ingest_cifar10(src: str, out: str) -> str:
+    # accept either the extracted dir or its parent
+    if not os.path.isfile(os.path.join(src, "data_batch_1")):
+        inner = os.path.join(src, "cifar-10-batches-py")
+        if os.path.isdir(inner):
+            src = inner
+    xs, ys = [], []
+    for i in range(1, 6):
+        batch = _unpickle(os.path.join(src, f"data_batch_{i}"))
+        xs.append(batch[b"data"])
+        ys.extend(batch[b"labels"])
+    x_train = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    y_train = np.asarray(ys, np.int32)
+    test = _unpickle(os.path.join(src, "test_batch"))
+    x_test = test[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    y_test = np.asarray(test[b"labels"], np.int32)
+    mean, std = _channel_stats(x_train)
+    return _write(
+        out,
+        "CIFAR10",
+        kind="vision",
+        x_train=x_train.astype(np.uint8),
+        y_train=y_train,
+        x_test=x_test.astype(np.uint8),
+        y_test=y_test,
+        mean=mean,
+        std=std,
+    )
+
+
+def ingest_cifar100(src: str, out: str) -> str:
+    if not os.path.isfile(os.path.join(src, "train")):
+        inner = os.path.join(src, "cifar-100-python")
+        if os.path.isdir(inner):
+            src = inner
+    train = _unpickle(os.path.join(src, "train"))
+    test = _unpickle(os.path.join(src, "test"))
+    x_train = train[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    x_test = test[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    mean, std = _channel_stats(x_train)
+    return _write(
+        out,
+        "CIFAR100",
+        kind="vision",
+        x_train=x_train.astype(np.uint8),
+        y_train=np.asarray(train[b"fine_labels"], np.int32),
+        x_test=x_test.astype(np.uint8),
+        y_test=np.asarray(test[b"fine_labels"], np.int32),
+        mean=mean,
+        std=std,
+    )
+
+
+# the SAME tokenizer the runtime uses (data/tokenizer.py), so train-time
+# and inference-time token ids agree by construction
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from distributed_learning_simulator_tpu.data.tokenizer import (  # noqa: E402
+    N_SPECIALS as _N_SPECIALS,
+    PAD_ID,
+    UNK_ID,
+    tokenize,
+)
+
+
+def build_vocab(token_lists, vocab_size: int) -> list[str]:
+    """Top-(vocab_size-2) train-split words by frequency (ties broken
+    lexicographically for determinism); ids start after pad=0, unk=1."""
+    from collections import Counter
+
+    counts = Counter()
+    for tokens in token_lists:
+        counts.update(tokens)
+    ranked = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+    return [w for w, _ in ranked[: max(0, vocab_size - _N_SPECIALS)]]
+
+
+def encode(token_lists, vocab: list[str], max_len: int) -> np.ndarray:
+    index = {w: i + _N_SPECIALS for i, w in enumerate(vocab)}
+    out = np.full((len(token_lists), max_len), PAD_ID, np.int32)
+    for row, tokens in enumerate(token_lists):
+        ids = [index.get(t, UNK_ID) for t in tokens[:max_len]]
+        out[row, : len(ids)] = ids
+    return out
+
+
+def _read_imdb_split(split_dir: str) -> tuple[list[list[str]], np.ndarray]:
+    docs, labels = [], []
+    for label, sub in ((1, "pos"), (0, "neg")):
+        paths = sorted(glob.glob(os.path.join(split_dir, sub, "*.txt")))
+        if not paths:
+            raise FileNotFoundError(f"no .txt reviews under {split_dir}/{sub}")
+        for path in paths:
+            with open(path, encoding="utf8", errors="replace") as f:
+                docs.append(tokenize(f.read()))
+            labels.append(label)
+    return docs, np.asarray(labels, np.int32)
+
+
+def ingest_imdb(
+    src: str, out: str, max_len: int = 300, vocab_size: int = 20000
+) -> str:
+    if not os.path.isdir(os.path.join(src, "train")):
+        inner = os.path.join(src, "aclImdb")
+        if os.path.isdir(inner):
+            src = inner
+    train_docs, y_train = _read_imdb_split(os.path.join(src, "train"))
+    test_docs, y_test = _read_imdb_split(os.path.join(src, "test"))
+    vocab = build_vocab(train_docs, vocab_size)
+    return _write(
+        out,
+        "imdb",
+        kind="text",
+        x_train=encode(train_docs, vocab, max_len),
+        y_train=y_train,
+        x_test=encode(test_docs, vocab, max_len),
+        y_test=y_test,
+        vocab_size=np.int64(len(vocab) + _N_SPECIALS),
+        max_len=np.int64(max_len),
+        pad_id=np.int64(PAD_ID),
+        vocab=np.asarray(vocab),
+    )
+
+
+def ingest_planetoid(src: str, out: str, name: str = "cora") -> str:
+    """The ind.<name>.{x,tx,allx,y,ty,ally,graph,test.index} pickle set
+    (Kipf planetoid distribution; scipy sparse matrices inside)."""
+    lname = name.lower()
+
+    def load(part: str):
+        with open(os.path.join(src, f"ind.{lname}.{part}"), "rb") as f:
+            return pickle.load(f, encoding="latin1")
+
+    allx, ally = load("allx"), load("ally")
+    tx, ty = load("tx"), load("ty")
+    graph = load("graph")
+    test_idx = np.loadtxt(
+        os.path.join(src, f"ind.{lname}.test.index"), dtype=np.int64
+    )
+
+    x_all = np.asarray(allx.todense(), np.float32)
+    x_test = np.asarray(tx.todense(), np.float32)
+    num_nodes = max(int(test_idx.max()) + 1, x_all.shape[0] + x_test.shape[0])
+    x = np.zeros((num_nodes, x_all.shape[1]), np.float32)
+    y_onehot = np.zeros((num_nodes, ally.shape[1]), np.float32)
+    x[: x_all.shape[0]] = x_all
+    y_onehot[: x_all.shape[0]] = ally
+    # tx/ty rows follow test.index file order (Kipf's loader pairs the i-th
+    # unsorted id with the i-th sorted row, an identity for the contiguous
+    # cora/pubmed ranges); citeseer's isolated nodes keep zero features
+    x[test_idx] = x_test
+    y_onehot[test_idx] = np.asarray(ty, np.float32)
+    y = y_onehot.argmax(axis=1).astype(np.int32)
+
+    src_nodes, dst_nodes = [], []
+    for node, neighbors in graph.items():
+        for neighbor in neighbors:
+            src_nodes.append(node)
+            dst_nodes.append(neighbor)
+    edge_index = np.asarray([src_nodes, dst_nodes], np.int32)
+    # symmetrize + dedup
+    both = np.concatenate([edge_index, edge_index[::-1]], axis=1)
+    both = np.unique(both, axis=1)
+
+    # standard planetoid split: first |y| train, next 500 val, test.index test
+    n_train = load("y").shape[0]
+    train_mask = np.zeros(num_nodes, bool)
+    val_mask = np.zeros(num_nodes, bool)
+    test_mask = np.zeros(num_nodes, bool)
+    train_mask[:n_train] = True
+    val_mask[n_train : n_train + 500] = True
+    test_mask[test_idx] = True
+
+    upper = {"cora": "Cora", "citeseer": "CiteSeer", "pubmed": "PubMed"}
+    return _write(
+        out,
+        upper.get(lname, name),
+        kind="graph",
+        x=x,
+        edge_index=both,
+        y=y,
+        train_mask=train_mask,
+        val_mask=val_mask,
+        test_mask=test_mask,
+    )
+
+
+def ingest_graph_npz(src: str, out: str, name: str) -> str:
+    """Passthrough for graphs already in x/edge_index/y/masks form (the
+    documented escape hatch for datasets with no standard offline format,
+    e.g. Coauthor_CS exported from another machine)."""
+    with np.load(src) as blob:
+        arrays = {k: blob[k] for k in blob.files}
+    required = {"x", "edge_index", "y", "train_mask", "val_mask", "test_mask"}
+    missing = required - set(arrays)
+    if missing:
+        raise KeyError(f"{src} missing graph keys: {sorted(missing)}")
+    arrays["kind"] = "graph"
+    return _write(out, name, **arrays)
+
+
+def ingest_glove(src: str, out: str) -> str:
+    """glove.<corpus>.<dim>d.txt -> glove.<dim>d.npz {words, vectors}; the
+    text models consume it via models/text.py when present (reference:
+    ``word_vector_name: glove.6B.100d``, conf/fed_avg/imdb.yaml:14)."""
+    def _float_tail(parts: list[str]) -> int:
+        """Longest float-parseable suffix, keeping at least one word field
+        (glove.840B tokens can contain spaces, e.g. '. . .')."""
+        n = 0
+        for part in reversed(parts[1:]):
+            try:
+                float(part)
+            except ValueError:
+                break
+            n += 1
+        return n
+
+    words, vectors = [], []
+    dim = 0
+    with open(src, encoding="utf8") as f:
+        for line in f:
+            parts = line.rstrip("\n").split(" ")
+            if len(parts) < 2:
+                continue
+            if not dim:
+                dim = _float_tail(parts)
+                if not dim:
+                    continue
+            words.append(" ".join(parts[:-dim]))
+            vectors.append(np.asarray(parts[-dim:], np.float32))
+    matrix = np.stack(vectors)
+    return _write(
+        out,
+        f"glove.{dim}d",
+        kind="embedding",
+        words=np.asarray(words),
+        vectors=matrix,
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    for cmd in ("mnist", "fashionmnist", "cifar10", "cifar100", "imdb",
+                "planetoid", "graph-npz", "glove"):
+        p = sub.add_parser(cmd)
+        p.add_argument("--src", required=True, help="source file/directory")
+        p.add_argument(
+            "--out",
+            default=os.environ.get("DLS_TPU_DATA_DIR", ""),
+            help="output dir (default: $DLS_TPU_DATA_DIR)",
+        )
+        if cmd == "planetoid":
+            p.add_argument("--name", default="cora",
+                           help="cora | citeseer | pubmed")
+        if cmd == "graph-npz":
+            p.add_argument("--name", required=True,
+                           help="registry dataset name, e.g. Coauthor_CS")
+        if cmd == "imdb":
+            p.add_argument("--max-len", type=int, default=300)
+            p.add_argument("--vocab-size", type=int, default=20000)
+    args = parser.parse_args(argv)
+    if not args.out:
+        parser.error("--out or $DLS_TPU_DATA_DIR required")
+    if args.cmd == "mnist":
+        ingest_mnist(args.src, args.out, "MNIST")
+    elif args.cmd == "fashionmnist":
+        ingest_mnist(args.src, args.out, "FashionMNIST")
+    elif args.cmd == "cifar10":
+        ingest_cifar10(args.src, args.out)
+    elif args.cmd == "cifar100":
+        ingest_cifar100(args.src, args.out)
+    elif args.cmd == "imdb":
+        ingest_imdb(args.src, args.out, args.max_len, args.vocab_size)
+    elif args.cmd == "planetoid":
+        ingest_planetoid(args.src, args.out, args.name)
+    elif args.cmd == "graph-npz":
+        ingest_graph_npz(args.src, args.out, args.name)
+    elif args.cmd == "glove":
+        ingest_glove(args.src, args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
