@@ -72,6 +72,19 @@ def _as_str(value) -> str:
     return str(item)
 
 
+def _halve_split(name: str, x_test, y_test):
+    """Deterministically shuffle before halving test into val/test — ingested
+    test splits can be label-sorted (aclImdb writes all pos then all neg), so
+    a sequential halving would yield single-class val and test sets."""
+    rng = np.random.default_rng(
+        int.from_bytes(name.encode()[:4].ljust(4, b"\0"), "little")
+    )
+    order = rng.permutation(len(x_test))
+    x_test, y_test = x_test[order], y_test[order]
+    n_val = max(1, len(x_test) // 2)
+    return x_test[:n_val], y_test[:n_val], x_test[n_val:], y_test[n_val:]
+
+
 def _normalize(x: np.ndarray, blob) -> np.ndarray:
     if x.dtype == np.uint8:
         x = x.astype(np.float32) / 255.0
@@ -92,9 +105,7 @@ def _vision_collection(name: str, blob) -> DatasetCollection:
         x_val = _normalize(blob["x_val"], blob)
         y_val = np.asarray(blob["y_val"], np.int32)
     else:
-        n_val = max(1, len(x_test) // 2)
-        x_val, y_val = x_test[:n_val], y_test[:n_val]
-        x_test, y_test = x_test[n_val:], y_test[n_val:]
+        x_val, y_val, x_test, y_test = _halve_split(name, x_test, y_test)
     num_classes = int(max(y_train.max(), y_test.max())) + 1
     return DatasetCollection(
         name=name,
@@ -133,7 +144,7 @@ def _text_collection(name: str, blob, max_len: int | None) -> DatasetCollection:
         if "vocab_size" in blob
         else int(max(x_train.max(), x_test.max())) + 1
     )
-    n_val = max(1, len(x_test) // 2)
+    x_val, y_val, x_test, y_test = _halve_split(name, x_test, y_test)
     metadata = {
         "real": True,
         "vocab_size": vocab_size,
@@ -147,8 +158,8 @@ def _text_collection(name: str, blob, max_len: int | None) -> DatasetCollection:
         name=name,
         datasets={
             Phase.Training: ArrayDataset(x_train, y_train),
-            Phase.Validation: ArrayDataset(x_test[:n_val], y_test[:n_val]),
-            Phase.Test: ArrayDataset(x_test[n_val:], y_test[n_val:]),
+            Phase.Validation: ArrayDataset(x_val, y_val),
+            Phase.Test: ArrayDataset(x_test, y_test),
         },
         num_classes=num_classes,
         input_shape=(want_len,),

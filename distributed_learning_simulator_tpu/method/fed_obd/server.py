@@ -1,8 +1,12 @@
-"""FedOBD server (reference ``simulation_lib/method/fed_obd/server.py:10-61``):
-phase state machine over the FedAvg aggregator — phase 1 rounds with random
-selection and quantized broadcast; switch to phase 2 when rounds are
-exhausted (or converged under early-stop); end on phase-2 plateau or worker
-``end_training``."""
+"""FedOBD server role — a thin adapter over the shared phase driver.
+
+Functional parity target: ``simulation_lib/method/fed_obd/server.py:10-61``
+(random selection + per-round stats in phase 1, all-worker per-epoch
+aggregation with ``check_acc`` stats in phase 2, plateau handling).  The
+round structure itself lives in :mod:`.driver`, shared with the SPMD
+session — this class only translates driver decisions into the threaded
+server's message flow.
+"""
 
 from typing import Any
 
@@ -11,58 +15,57 @@ from ...message import ParameterMessageBase
 from ...server.aggregation_server import AggregationServer
 from ...topology.quantized_endpoint import QuantServerEndpoint
 from ...utils.logging import get_logger
-from .phase import Phase
+from .driver import ObdRoundDriver
 
 
 class FedOBDServer(AggregationServer):
     def __init__(self, **kwargs: Any) -> None:
         kwargs.setdefault("algorithm", FedAVGAlgorithm())
         super().__init__(**kwargs)
-        self.__phase: Phase = Phase.STAGE_ONE
+        self._driver = ObdRoundDriver.from_config(self.config)
         assert isinstance(self._endpoint, QuantServerEndpoint)
+        # global-model broadcasts ride the same codec as uploads
         self._endpoint.quant_broadcast = True
 
     def _select_workers(self) -> set[int]:
-        if self.__phase != Phase.STAGE_ONE:
-            return set(range(self.worker_number))
-        return super()._select_workers()
+        phase = self._driver.phase
+        if phase is not None and not phase.select_all:
+            return super()._select_workers()
+        return set(range(self.worker_number))
 
     def _get_stat_key(self) -> int:
+        # epoch-cadence records land while the round counter is frozen
+        # (``in_round`` uploads), so stat keys append past whatever exists
         if not self.performance_stat:
             return super()._get_stat_key()
         return max(self.performance_stat.keys()) + 1
 
+    def _maybe_early_stop(self, result) -> None:
+        """No-op: the phase driver owns plateau handling (phase-1 plateau
+        switches phases, it must not end the run)."""
+
     def _aggregate_worker_data(self) -> ParameterMessageBase:
         result = super()._aggregate_worker_data()
         assert result is not None
-        self._compute_stat = False
-        if self.__phase == Phase.STAGE_ONE:
-            self._compute_stat = True
-        if "check_acc" in result.other_data:
-            self._compute_stat = True
-        if result.end_training:
-            self.__phase = Phase.END
-        match self.__phase:
-            case Phase.STAGE_ONE:
-                if self.round_number >= self.config.round or (
-                    self.early_stop and not self.__has_improvement()
-                ):
-                    get_logger().info("switch to phase 2")
-                    self.__phase = Phase.STAGE_TWO
-                    result.other_data["phase_two"] = True
-            case Phase.STAGE_TWO:
-                if self.early_stop and not self.__has_improvement():
-                    get_logger().info("stop aggregation")
-                    result.end_training = True
-            case Phase.END:
-                pass
+        improved = True
+        if self._driver.early_stop and self.performance_stat:
+            improved = not self._convergent()
+        decision = self._driver.after_aggregate(
+            improved=improved,
+            worker_ended=result.end_training,
+            check_acc="check_acc" in result.other_data,
+        )
+        self._compute_stat = decision.record_metric
+        if decision.annotations:
+            get_logger().info(
+                "phase switch -> %s", self._driver.phase and self._driver.phase.name
+            )
+            result.other_data.update(decision.annotations)
+        if decision.end_training:
+            get_logger().info("stop aggregation")
+            result.end_training = True
+            self._driver.stop_now()
         return result
 
     def _stopped(self) -> bool:
-        return self.__phase == Phase.END
-
-    def __has_improvement(self) -> bool:
-        # the reference short-circuits phase 2 to "always improving"
-        # (method/fed_obd/server.py:57-60), making its documented phase-2
-        # plateau stop dead code; here phase 2 also uses the plateau test
-        return not self._convergent()
+        return self._driver.finished

@@ -1,7 +1,13 @@
-"""FedOBD client (reference ``simulation_lib/method/fed_obd/worker.py:12-74``):
-phase 1 uploads block-dropout'd partial parameters through a quantized
-endpoint; on the server's ``phase_two`` signal switches to per-epoch
-``in_round`` aggregation with lr reuse for ``second_phase_epoch`` epochs."""
+"""FedOBD client role — spec-driven, block selection by composition.
+
+Functional parity target: ``simulation_lib/method/fed_obd/worker.py:12-74``
+(phase 1: block-dropout'd uploads through the quantized endpoint; phase 2:
+per-epoch ``in_round`` aggregation with lr reuse for ``second_phase_epoch``
+epochs, ``end_training`` on the last one).  All phase *meaning* comes from
+the shared :class:`~.driver.PhaseSpec` records; this class only applies
+whatever spec the server's annotation names — it holds no transition rules
+of its own.
+"""
 
 from typing import Any
 
@@ -10,56 +16,54 @@ from ...ml_type import ExecutorHookPoint
 from ...topology.quantized_endpoint import QuantClientEndpoint
 from ...utils.logging import get_logger
 from ...worker.aggregation_worker import AggregationWorker
+from .driver import BLOCK_DROPOUT_ROUNDS, EPOCH_TUNE, PHASE_TWO_KEY, PhaseSpec
 from .obd_algorithm import OpportunisticBlockDropoutAlgorithm
-from .phase import Phase
 
 
-class FedOBDWorker(AggregationWorker, OpportunisticBlockDropoutAlgorithm):
+class FedOBDWorker(AggregationWorker):
     def __init__(self, *args: Any, **kwargs: Any) -> None:
-        AggregationWorker.__init__(self, *args, **kwargs)
-        OpportunisticBlockDropoutAlgorithm.__init__(
-            self,
+        super().__init__(*args, **kwargs)
+        self._block_selector = OpportunisticBlockDropoutAlgorithm(
             dropout_rate=self.config.algorithm_kwargs["dropout_rate"],
             worker_id=self.worker_id,
         )
-        self.__phase = Phase.STAGE_ONE
-        self.__end_training = False
+        self._spec: PhaseSpec = BLOCK_DROPOUT_ROUNDS
+        self._last_epoch_announced = False
         assert isinstance(self._endpoint, QuantClientEndpoint)
         self._endpoint.dequant_server_data = True
-        self._send_parameter_diff = False
+        self._apply_spec(self._spec)
 
-    def _load_result_from_server(self, result: Message) -> None:
-        if "phase_two" in result.other_data:
-            assert isinstance(result, ParameterMessage)
-            self.__phase = Phase.STAGE_TWO
-            get_logger().info("%s switches to phase 2", self.name)
-            self._reuse_learning_rate = True
-            self._send_parameter_diff = True
-            self.disable_choose_model_by_validation()
-            self.trainer.hyper_parameter.epoch = self.config.algorithm_kwargs[
-                "second_phase_epoch"
-            ]
-            self.config.round = self._round_num + 1
+    # ---- spec application (client-side meaning of a phase) ----
+    def _apply_spec(self, spec: PhaseSpec) -> None:
+        self._spec = spec
+        self._send_parameter_diff = not spec.block_dropout
+        self._reuse_learning_rate = spec.reuse_learning_rate
+        if spec.epoch_cadence:
             self._aggregation_time = ExecutorHookPoint.AFTER_EPOCH
-            self._register_aggregation()
+
+    def _enter_epoch_tune(self) -> None:
+        get_logger().info("%s switches to %s", self.name, EPOCH_TUNE.name)
+        self._apply_spec(EPOCH_TUNE)
+        self.disable_choose_model_by_validation()
+        self.trainer.hyper_parameter.epoch = self.config.algorithm_kwargs[
+            "second_phase_epoch"
+        ]
+        # one more Worker.start() iteration runs the whole tuning phase
+        self.config.round = self._round_num + 1
+        self._register_aggregation()
+
+    # ---- message flow ----
+    def _load_result_from_server(self, result: Message) -> None:
+        if PHASE_TWO_KEY in result.other_data:
+            assert isinstance(result, ParameterMessage)
+            self._enter_epoch_tune()
         super()._load_result_from_server(result=result)
-
-    def _aggregation(self, sent_data: Message, **kwargs: Any) -> None:
-        if self.__phase == Phase.STAGE_TWO:
-            executor = kwargs["executor"]
-            if kwargs["epoch"] == executor.hyper_parameter.epoch:
-                sent_data.end_training = True
-                self.__end_training = True
-        super()._aggregation(sent_data=sent_data, **kwargs)
-
-    def _stopped(self) -> bool:
-        return self.__end_training or super()._stopped()
 
     def _get_sent_data(self) -> Message:
         data = super()._get_sent_data()
-        if self.__phase == Phase.STAGE_ONE:
+        if self._spec.block_dropout:
             assert isinstance(data, ParameterMessage)
-            kept = self.get_block_parameter(
+            kept = self._block_selector.get_block_parameter(
                 parameter_dict=data.parameter, model_cache=self._model_cache
             )
             # ship the kept blocks as DIFFS vs the cached global (reference
@@ -78,5 +82,18 @@ class FedOBDWorker(AggregationWorker, OpportunisticBlockDropoutAlgorithm):
                 end_training=data.end_training,
             )
         data.in_round = True
-        data.other_data["check_acc"] = True
+        if self._spec.check_acc:
+            data.other_data["check_acc"] = True
         return data
+
+    def _aggregation(self, sent_data: Message, **kwargs: Any) -> None:
+        if self._spec.epoch_cadence:
+            executor = kwargs["executor"]
+            if kwargs["epoch"] == executor.hyper_parameter.epoch:
+                # last tuning epoch: announce the end of the run
+                sent_data.end_training = True
+                self._last_epoch_announced = True
+        super()._aggregation(sent_data=sent_data, **kwargs)
+
+    def _stopped(self) -> bool:
+        return self._last_epoch_announced or super()._stopped()

@@ -281,11 +281,15 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         return weights
 
     def run(self) -> dict:
+        """Drive the phases off the SAME :class:`ObdRoundDriver` the
+        threaded server uses (``method/fed_obd/driver.py``) — the round
+        structure has exactly one definition across executors."""
+        from ..method.fed_obd.driver import ObdRoundDriver
+
         config = self.config
         save_dir = os.path.join(config.save_dir, "server")
         os.makedirs(save_dir, exist_ok=True)
-        early_stop = bool(config.algorithm_kwargs.get("early_stop", False))
-        second_phase_epoch = int(config.algorithm_kwargs["second_phase_epoch"])
+        driver = ObdRoundDriver.from_config(config)
         train_params = put_sharded(
             self.engine.init_params(config.seed), self._replicated
         )
@@ -303,31 +307,36 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 k: float(np.asarray(v)) for k, v in metrics.items()
             }
 
+        tick = 0
         with self._ckpt:  # flush async round checkpoints at exit
-            # ---- phase 1: rounds with random selection + block dropout ----
-            for round_number in range(1, config.round + 1):
-                exact, train_params, met = step(
-                    self._phase1_fn, train_params, self._select_weights(round_number)
-                )
-                metric = self._evaluate(exact)
-                self._record_obd(round_number, metric, met, exact, save_dir)
-                if early_stop and not self._has_improvement():
-                    get_logger().info("phase 1 convergent, switching early")
-                    break
-            get_logger().info("switch to phase 2")
-
-            # ---- phase 2: per-epoch aggregation over all clients ----
-            if self._phase2_fn is None:
-                self._phase2_fn = self._build_phase_fn(phase_two=True)
-            for _ in range(second_phase_epoch):
-                exact, train_params, met = step(
-                    self._phase2_fn, train_params, self._all_weights()
-                )
-                metric = self._evaluate(exact)  # check_acc semantics
-                stat_key = max(self._stat) + 1 if self._stat else 1
+            while not driver.finished:
+                spec = driver.phase
+                if spec.block_dropout:
+                    fn = self._phase1_fn
+                    tick += 1
+                    weights = self._select_weights(tick)
+                    stat_key = tick
+                else:
+                    if self._phase2_fn is None:
+                        self._phase2_fn = self._build_phase_fn(phase_two=True)
+                    fn = self._phase2_fn
+                    weights = self._all_weights()
+                    stat_key = max(self._stat) + 1 if self._stat else 1
+                exact, train_params, met = step(fn, train_params, weights)
+                metric = self._evaluate(exact)  # phase 2: check_acc semantics
                 self._record_obd(stat_key, metric, met, exact, save_dir)
-                if early_stop and not self._has_improvement():
-                    get_logger().info("phase 2 plateau, stopping")
+                improved = True
+                if driver.early_stop:
+                    improved = self._has_improvement()
+                decision = driver.after_aggregate(
+                    improved=improved, check_acc=spec.check_acc
+                )
+                if decision.annotations:
+                    get_logger().info(
+                        "phase switch -> %s",
+                        driver.phase and driver.phase.name,
+                    )
+                if decision.end_training:
                     break
         return {"performance": self._stat}
 

@@ -146,8 +146,7 @@ class AggregationServer(Server):
             self.__stat[0] = self.__stat.pop(self._get_stat_key())
         elif self._compute_stat and "init" not in result.other_data:
             self.__record_compute_stat(result.parameter)
-            if not result.end_training and self.early_stop and self._convergent():
-                result.end_training = True
+            self._maybe_early_stop(result)
         elif result.end_training:
             self.__record_compute_stat(result.parameter)
         model_path = os.path.join(
@@ -207,6 +206,14 @@ class AggregationServer(Server):
                 os.path.join(self.save_dir, "best_global_model.npz"),
                 **{k: np.asarray(v) for k, v in parameter_dict.items()},
             )
+
+    def _maybe_early_stop(self, result: Message) -> None:
+        """Default plateau stop after each recorded round metric.  Methods
+        owning their own phase progression (FedOBD's driver) override this
+        to a no-op so ``_convergent``'s plateau counter has exactly one
+        caller."""
+        if not result.end_training and self.early_stop and self._convergent():
+            result.end_training = True
 
     def _convergent(self) -> bool:
         """5-round accuracy plateau (reference ``aggregation_server.py:166-184``;

@@ -73,6 +73,22 @@ def test_fed_obd(tmp_session_dir):
     run(config)
 
 
+def test_fed_obd_early_stop(tmp_session_dir):
+    """early_stop threads through the phase driver from round 1 (empty
+    performance_stat must not crash the plateau test)."""
+    config = tiny_config(
+        "fed_obd",
+        round=2,
+        algorithm_kwargs={
+            "second_phase_epoch": 1,
+            "dropout_rate": 0.5,
+            "early_stop": True,
+        },
+        endpoint_kwargs={"server": {"weight": 0.01}, "worker": {"weight": 0.01}},
+    )
+    run(config)
+
+
 def test_fed_obd_sq(tmp_session_dir):
     """fed_obd with StochasticQuant endpoints instead of NNADQ (reference
     ``method/fed_obd/__init__.py:16-22``)."""

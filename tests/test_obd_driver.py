@@ -1,0 +1,67 @@
+"""Transition rules of the shared FedOBD phase driver (one source of truth
+for both executors, ``method/fed_obd/driver.py``)."""
+
+from distributed_learning_simulator_tpu.method.fed_obd.driver import (
+    BLOCK_DROPOUT_ROUNDS,
+    EPOCH_TUNE,
+    PHASE_TWO_KEY,
+    ObdRoundDriver,
+)
+
+
+def test_budget_driven_progression():
+    driver = ObdRoundDriver(total_rounds=3, second_phase_epoch=2, early_stop=False)
+    assert driver.phase is BLOCK_DROPOUT_ROUNDS
+    # rounds 1..2: plain continue, metric recorded
+    for _ in range(2):
+        decision = driver.after_aggregate()
+        assert not decision.annotations and not decision.end_training
+        assert decision.record_metric
+        assert driver.phase is BLOCK_DROPOUT_ROUNDS
+    # round 3 exhausts the budget -> announce phase 2
+    decision = driver.after_aggregate()
+    assert decision.annotations == {PHASE_TWO_KEY: True}
+    assert driver.phase is EPOCH_TUNE
+    # epoch 1: in_round record only with check_acc
+    decision = driver.after_aggregate(check_acc=True)
+    assert decision.record_metric and not decision.end_training
+    assert driver.after_aggregate(check_acc=False).record_metric is False
+    # epoch budget spent -> finished
+    assert driver.finished
+
+
+def test_epoch_budget_sets_end_training():
+    driver = ObdRoundDriver(total_rounds=1, second_phase_epoch=1, early_stop=False)
+    assert driver.after_aggregate().annotations == {PHASE_TWO_KEY: True}
+    decision = driver.after_aggregate(check_acc=True)
+    assert decision.end_training
+    assert driver.finished
+
+
+def test_plateau_switches_then_stops():
+    driver = ObdRoundDriver(total_rounds=100, second_phase_epoch=100, early_stop=True)
+    assert not driver.after_aggregate(improved=True).annotations
+    # phase-1 plateau switches instead of ending
+    decision = driver.after_aggregate(improved=False)
+    assert decision.annotations == {PHASE_TWO_KEY: True}
+    assert not decision.end_training
+    # phase-2 plateau ends the run
+    decision = driver.after_aggregate(improved=False, check_acc=True)
+    assert decision.end_training
+    assert driver.finished
+
+
+def test_worker_end_signal_wins():
+    driver = ObdRoundDriver(total_rounds=2, second_phase_epoch=5, early_stop=False)
+    driver.after_aggregate()
+    driver.after_aggregate()  # -> phase 2
+    decision = driver.after_aggregate(worker_ended=True, check_acc=True)
+    # the message already carries end_training; driver just winds down
+    assert not decision.end_training and decision.record_metric
+    assert driver.finished
+
+
+def test_early_stop_disabled_ignores_improved_flag():
+    driver = ObdRoundDriver(total_rounds=2, second_phase_epoch=1, early_stop=False)
+    assert not driver.after_aggregate(improved=False).annotations
+    assert driver.phase is BLOCK_DROPOUT_ROUNDS
