@@ -40,16 +40,19 @@ class GTGShapleyValue(ShapleyValueEngine):
         self.max_percentage_of_permutations = max_percentage_of_permutations
         self._rng = np.random.default_rng(seed)
 
+    #: runaway safety valve, NOT a sampling budget — the real stops are
+    #: ``convergence_threshold`` and ``max_percentage_of_permutations``
+    PERMUTATION_CEILING = 10_000
+
     def _max_permutations(self) -> int:
         n = len(self.players)
         total = 1
         for i in range(2, n + 1):
             total *= i
-            if total > 10000:
+            if total > self.PERMUTATION_CEILING:
                 break
-        bound = max(n, int(min(total, 10000) * self.max_percentage_of_permutations))
-        # GTG uses O(n log n)-ish samples in practice; cap generously
-        return min(bound, max(2 * n, 20))
+        total = min(total, self.PERMUTATION_CEILING)
+        return max(n, int(total * self.max_percentage_of_permutations))
 
     def compute(self, round_number: int) -> None:
         players = self.players
