@@ -35,11 +35,9 @@ class HierarchicalShapleyValueAlgorithm(ShapleyValueAlgorithm):
         super().__init__(HierarchicalShapleyValue, *args, **kwargs)
 
     def _sv_engine_kwargs(self) -> dict:
-        kwargs = super()._sv_engine_kwargs()
-        for key in ("part_number", "vp_size"):
-            if key in self.config.algorithm_kwargs:
-                kwargs[key] = self.config.algorithm_kwargs[key]
-        return kwargs
+        from ...shapley import sv_engine_kwargs
+
+        return sv_engine_kwargs(self.config, hierarchical=True)
 
 
 class GTGShapleyValueServer(ShapleyValueServer):

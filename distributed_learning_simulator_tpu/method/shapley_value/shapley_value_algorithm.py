@@ -45,7 +45,9 @@ class ShapleyValueAlgorithm(FedAVGAlgorithm):
     def _sv_engine_kwargs(self) -> dict:
         """Engine ctor kwargs beyond (players, last_round_metric);
         subclasses add their config surface (e.g. hierarchical grouping)."""
-        return dict(self.config.algorithm_kwargs.get("sv_kwargs", {}))
+        from ...shapley import sv_engine_kwargs
+
+        return sv_engine_kwargs(self.config, hierarchical=False)
 
     def aggregate_worker_data(self) -> Message:
         if self.sv_algorithm is None:

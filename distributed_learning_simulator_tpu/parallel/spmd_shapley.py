@@ -133,6 +133,17 @@ class SpmdShapleySession(SpmdFedAvgSession):
 
         return workers, metric_many
 
+    def _engine_kwargs(self) -> dict:
+        """Same engine configuration as the threaded servers — shared
+        definition in ``shapley.sv_engine_kwargs``."""
+        from ..shapley import sv_engine_kwargs
+
+        return sv_engine_kwargs(
+            self.config,
+            hierarchical=self.config.distributed_algorithm
+            == "Hierarchical_shapley_value",
+        )
+
     def run(self) -> dict:
         config = self.config
         save_dir = os.path.join(config.save_dir, "server")
@@ -178,7 +189,7 @@ class SpmdShapleySession(SpmdFedAvgSession):
                 self._sv_engine = self._engine_cls(
                     players=workers,
                     last_round_metric=self._stat[0]["test_accuracy"],
-                    **dict(config.algorithm_kwargs.get("sv_kwargs", {})),
+                    **self._engine_kwargs(),
                 )
             self._sv_engine.set_metric_function(
                 lambda subset: metric_many([subset])[0]

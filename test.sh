@@ -16,9 +16,10 @@ run --config-name fed_gnn/cs.yaml \
 run --config-name gtg_sv/mnist.yaml \
   ++gtg_sv.round=1 ++gtg_sv.epoch=1 ++gtg_sv.worker_number=2
 
-# dataset bounded so the simulation-faithful executor stays CPU-friendly
-# (the reference's smoke assumed CUDA); full-size runs are the canonical
-# launchers (fed_obd_train.sh) on accelerator hardware.  NOTE: XLA:CPU
+# dataset bounded so the smoke stays CPU-friendly (the reference's smoke
+# assumed CUDA); executor=auto hits the SPMD fast path for every built-in
+# method.  Full-size runs are the canonical launchers (fed_obd_train.sh)
+# on accelerator hardware.  NOTE: XLA:CPU
 # compiles the densenet40 train program in ~10 min (one-off per process;
 # fast on TPU) — this line is the slow one on a CPU-only host
 run --config-name fed_obd/cifar10.yaml \

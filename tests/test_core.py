@@ -134,7 +134,7 @@ def test_slow_performance_metrics(tmp_path):
     from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
     from distributed_learning_simulator_tpu.training import train
 
-    for executor in ("spmd", "auto"):
+    for executor in ("spmd", "sequential"):
         config = DistributedTrainingConfig(
             dataset_name="MNIST",
             model_name="LeNet5",

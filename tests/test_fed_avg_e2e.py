@@ -13,6 +13,9 @@ def make_config(**overrides) -> DistributedTrainingConfig:
         dataset_name="MNIST",
         model_name="LeNet5",
         distributed_algorithm="fed_avg",
+        # reference-parity e2e: the threaded executor (SPMD e2e lives in
+        # test_spmd*.py / test_executor_matrix.py)
+        executor="sequential",
         optimizer_name="SGD",
         worker_number=2,
         batch_size=32,

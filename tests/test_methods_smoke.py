@@ -18,6 +18,10 @@ def tiny_config(algo: str, **overrides) -> DistributedTrainingConfig:
         round=1,
         epoch=1,
         learning_rate=0.05,
+        # this file IS the threaded-executor parity matrix (auto now
+        # resolves to spmd; the SPMD matrix lives in test_spmd_methods +
+        # test_executor_matrix)
+        executor="sequential",
         dataset_kwargs={"train_size": 128, "val_size": 32, "test_size": 32},
     )
     for key, value in overrides.items():
@@ -107,6 +111,7 @@ def test_fed_gcn(tmp_session_dir):
         dataset_name="Cora",
         model_name="TwoGCN",
         distributed_algorithm="fed_gcn",
+        executor="sequential",
         worker_number=2,
         round=1,
         epoch=1,
@@ -138,6 +143,7 @@ def test_fed_gnn(tmp_session_dir):
         dataset_name="Cora",
         model_name="TwoGCN",
         distributed_algorithm="fed_gnn",
+        executor="sequential",
         worker_number=2,
         round=1,
         epoch=1,

@@ -84,7 +84,7 @@ def test_spmd_session_consumes_partition(tmp_session_dir):
     assert len(set(expected.values())) > 1 or WORKERS == 1
 
 
-@pytest.mark.parametrize("executor", ["spmd", "auto"])
+@pytest.mark.parametrize("executor", ["spmd", "sequential"])
 def test_runs_end_to_end(executor, tmp_session_dir):
     """Round completes under the non-IID split on each executor (partition
     consumption itself is asserted by test_spmd_session_consumes_partition;

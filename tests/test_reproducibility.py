@@ -37,7 +37,7 @@ def _run(tmp_path, executor, tag):
     return result["performance"], params
 
 
-@pytest.mark.parametrize("executor", ["spmd", "auto"])
+@pytest.mark.parametrize("executor", ["spmd", "sequential"])
 def test_same_config_same_results(executor, tmp_session_dir):
     stat_a, params_a = _run(tmp_session_dir, executor, "a")
     stat_b, params_b = _run(tmp_session_dir, executor, "b")

@@ -91,7 +91,7 @@ def test_spmd_matches_threaded_fed_avg_statistically():
         )
         return train(config)["performance"][2]
 
-    threaded = run("auto")
+    threaded = run("sequential")  # auto now resolves to spmd for built-ins
     spmd = run("spmd")
     assert abs(threaded["test_accuracy"] - spmd["test_accuracy"]) < 0.2
     assert abs(threaded["test_loss"] - spmd["test_loss"]) < 0.5

@@ -45,6 +45,7 @@ def test_hierarchical_sv_e2e():
         dataset_name="MNIST",
         model_name="LeNet5",
         distributed_algorithm="Hierarchical_shapley_value",
+        executor="sequential",
         worker_number=6,
         batch_size=16,
         round=1,
@@ -66,6 +67,7 @@ def test_fed_aas_e2e():
         dataset_name="Cora",
         model_name="SimpleGCN",
         distributed_algorithm="fed_aas",
+        executor="sequential",
         worker_number=2,
         batch_size=16,
         round=2,

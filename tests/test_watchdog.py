@@ -97,6 +97,7 @@ def test_stalled_training_raises(tmp_session_dir):
         dataset_name="MNIST",
         model_name="LeNet5",
         distributed_algorithm="fed_avg",
+        executor="sequential",  # the watchdog guards the threaded fabric
         worker_number=2,
         batch_size=16,
         round=1,
