@@ -54,6 +54,11 @@ class DistributedTrainingConfig:
     exp_name: str = ""
     log_file: str = ""
     # --- global flags (conf/global.yaml) ---
+    # reference knob for where dataset transforms are cached (cpu/device).
+    # Here transforms are pre-applied at ingest and splits live as host
+    # arrays, so "cpu" (the default) is always effectively on; "device"
+    # additionally keeps epoch batches device-resident — which the SPMD
+    # executor does unconditionally.  Unknown values are rejected at load.
     cache_transforms: str = "cpu"
     log_level: str = "INFO"
     debug: bool = False
@@ -76,6 +81,12 @@ class DistributedTrainingConfig:
         (``config.py:36-54``: ``session/<algo>/<dataset>_<sampling>/<model>/<date>/<uuid>``)."""
         if overrides:
             apply_overrides(self, overrides)
+        cache = str(self.cache_transforms or "none").lower()
+        if cache not in ("cpu", "device", "none"):
+            raise ValueError(
+                f"cache_transforms must be cpu|device|none, got "
+                f"{self.cache_transforms!r}"
+            )
         if not self.save_dir:
             date = datetime.datetime.now().strftime("%Y-%m-%d_%H_%M_%S")
             task_name = f"{self.dataset_name}_{self.dataset_sampling}"

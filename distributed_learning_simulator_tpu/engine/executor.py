@@ -265,9 +265,27 @@ class Trainer(ExecutorBase):
 class Inferencer(ExecutorBase):
     def __init__(self, *args, phase=MachineLearningPhase.Test, **kwargs) -> None:
         super().__init__(*args, phase=phase, **kwargs)
+        self._cached_batches = None
+
+    def _eval_batches(self):
+        """Eval batches under the ``cache_transforms`` policy (reference
+        global knob, ``conf/global.yaml:1``): the split is fixed and the
+        slicing deterministic, so "cpu" caches the host batch list across
+        rounds and "device" keeps it device-resident (saves the per-round
+        test-set re-upload on the threaded path — the SPMD executor always
+        does this); "none" rebuilds every call."""
+        cache = str(self.config.cache_transforms or "none").lower()
+        if cache == "none":
+            return self._epoch_batches(self.phase, shuffle_seed=None)
+        if self._cached_batches is None:
+            batches = self._epoch_batches(self.phase, shuffle_seed=None)
+            if cache == "device":
+                batches = jax.device_put(batches)
+            self._cached_batches = batches
+        return self._cached_batches
 
     def inference(self) -> dict[str, float]:
-        batches = self._epoch_batches(self.phase, shuffle_seed=None)
+        batches = self._eval_batches()
         summed = self.engine.evaluate(self.params, batches)
         metrics = summarize_metrics(summed)
         metrics.update(

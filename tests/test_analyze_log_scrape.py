@@ -1,0 +1,155 @@
+"""Multi-run log-scrape parity (VERDICT r1 item 9): the reference's
+``compute_acc`` / ``compute_data_amount`` surface
+(``simulation_lib/analysis/analyze_log.py:14-66,69-279``) on fixture log
+trees in BOTH log spellings (reference percent lines and this framework's
+fraction lines)."""
+
+import numpy as np
+import pytest
+
+from distributed_learning_simulator_tpu.analysis.analyze_log import (
+    CommunicationCostModel,
+    compute_acc,
+    compute_data_amount,
+)
+
+
+def test_compute_acc_reference_format(tmp_path, capsys):
+    """Reference-style logs: 'test in ... accuracy ... 85.3%' lines, last
+    one wins, mean/std across runs, per-worker train accuracies."""
+    accs = [85.3, 87.1, 86.0]
+    paths = []
+    for i, acc in enumerate(accs):
+        lines = [
+            "round: 1, test in dataset accuracy is 50.0%\n",
+            f"worker 0 train accuracy: {70 + i}.0%\n",
+            f"worker 1 train accuracy: {75 + i}.0%\n",
+            f"round: 2, test in dataset accuracy is {acc}%\n",
+        ]
+        p = tmp_path / f"run{i}.log"
+        p.write_text("".join(lines))
+        paths.append(str(p))
+    result = compute_acc(paths, worker_number=2)
+    assert result["final_test_acc"] == accs
+    assert result["mean"] == pytest.approx(np.mean(accs))
+    assert result["std"] == pytest.approx(np.std(accs, ddof=1))
+    assert result["worker_acc"][0] == [70.0, 71.0, 72.0]
+    assert result["worker_acc"][1] == [75.0, 76.0, 77.0]
+    out = capsys.readouterr().out
+    assert "test acc" in out  # the reference's summary line
+
+
+def test_compute_acc_framework_format(tmp_path):
+    """This framework's fraction spellings normalize to percent scale, so
+    mixed reference/framework log sets aggregate in one unit."""
+    p = tmp_path / "run.log"
+    p.write_text(
+        "round: 1, test accuracy 0.1094 loss 2.2835\n"
+        "worker 1 epoch 1 loss 0.5 acc 0.7000 (1.2s)\n"
+        "worker 11 epoch 1 loss 0.4 acc 0.9000 (1.2s)\n"
+        "round: 2, test accuracy 0.8530 loss 0.4000\n"
+    )
+    result = compute_acc([str(p)], worker_number=12)
+    assert result["final_test_acc"] == [pytest.approx(85.3)]
+    # \b-anchored ids: worker 1 must not inherit worker 11's line
+    assert result["worker_acc"][1] == [pytest.approx(70.0)]
+    assert result["worker_acc"][11] == [pytest.approx(90.0)]
+
+
+def test_compute_acc_sign_sgd_family(tmp_path):
+    p = tmp_path / "run.log"
+    p.write_text("epoch 3 test loss 0.5 accuracy 91.0%\nnoise\n")
+    result = compute_acc([str(p)], distributed_algorithm="sign_SGD")
+    assert result["final_test_acc"] == [91.0]
+
+
+def test_compute_acc_obd_first_stage_family(tmp_path):
+    """fed_obd_first_stage only accepts the configured final round's line."""
+    p = tmp_path / "run.log"
+    p.write_text(
+        "round: 2, test in dataset accuracy is 60.0%\n"
+        "round: 3, test in dataset accuracy is 70.0%\n"
+        "round: 2, test in dataset accuracy is 61.0%\n"
+    )
+    result = compute_acc(
+        [str(p)], distributed_algorithm="fed_obd_first_stage", rounds=3
+    )
+    assert result["final_test_acc"] == [70.0]
+
+
+def test_data_amount_fed_avg_closed_form():
+    result = compute_data_amount(
+        [],
+        distributed_algorithm="fed_avg",
+        parameter_count=1000,
+        worker_number=4,
+        rounds=3,
+    )
+    # 2 * rounds * clients + init distribution, 4-byte params
+    assert result["msg_num"] == 2 * 3 * 4 + 4
+    expected_mb = 1000 * 4 * (2 * 3 * 4 + 4) / (1024 * 1024)
+    assert result["data_amount"] == pytest.approx(expected_mb, abs=0.01)
+
+
+def test_data_amount_fed_obd_scrapes_ratios(tmp_path):
+    logs = []
+    for i, ratio in enumerate((0.05, 0.07)):
+        p = tmp_path / f"run{i}.log"
+        p.write_text(
+            f"NNADQClientEndpoint compression ratio: {ratio}\n"
+            f"NNADQServerEndpoint compression ratio: {ratio * 2}\n"
+        )
+        logs.append(str(p))
+    result = compute_data_amount(
+        logs,
+        distributed_algorithm="fed_obd",
+        parameter_count=10_000,
+        worker_number=10,
+        rounds=5,
+        algorithm_kwargs={
+            "dropout_rate": 0.3,
+            "second_phase_epoch": 2,
+            "random_client_number": 5,
+        },
+    )
+    assert result["msg_num"] == 2 * 5 * 5 + 10 + 2 * 10 * 2
+    assert set(result["data_amount"]) == {"mean", "std"}
+    model = CommunicationCostModel(10_000, 10, 5)
+    expected = [
+        model.fed_obd_bytes(
+            dropout_rate=0.3,
+            compression_ratios=[r, r * 2],
+            selected_per_round=5,
+            second_phase_msgs=2 * 10 * 2,
+        )
+        / (1024 * 1024)
+        for r in (0.05, 0.07)
+    ]
+    assert result["data_amount"]["mean"] == pytest.approx(
+        np.mean(expected), abs=0.01
+    )
+
+
+def test_data_amount_send_num_family(tmp_path):
+    p = tmp_path / "run.log"
+    p.write_text("worker 0 send_num 500\nworker 1 send_num 700\n")
+    result = compute_data_amount(
+        [str(p)],
+        distributed_algorithm="fed_dropout_avg",
+        parameter_count=1000,
+        worker_number=2,
+        rounds=3,
+    )
+    expected = (500 + 700 + 3 * 2 * 1000) * 4 / (1024 * 1024)
+    assert result["data_amount"]["mean"] == pytest.approx(expected, abs=0.01)
+
+
+def test_cache_transforms_rejected_loudly():
+    from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST", model_name="LeNet5", distributed_algorithm="fed_avg"
+    )
+    config.cache_transforms = "gpu_magic"
+    with pytest.raises(ValueError, match="cache_transforms"):
+        config.load_config_and_process()
