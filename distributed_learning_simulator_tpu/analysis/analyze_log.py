@@ -105,10 +105,11 @@ def _is_final_acc_line(line: str, distributed_algorithm: str, rounds: int) -> bo
     if distributed_algorithm == "sign_SGD":
         return "test loss" in line or "test accuracy" in line
     if distributed_algorithm in ("fed_obd_first_stage", "fed_obd_layer"):
+        # \b-anchored: 'round: 2' must not substring-match 'round: 25'
         return (
             ("test in" in line or "test accuracy" in line)
             and "accuracy" in line
-            and f"round: {rounds}" in line
+            and re.search(rf"round: {rounds}\b", line) is not None
         )
     return ("test in" in line and "accuracy" in line) or "test accuracy" in line
 
@@ -271,7 +272,7 @@ def main(argv=None) -> None:
     args = parser.parse_args(argv)
     logfiles = args.logfiles
     if logfiles is None and os.getenv("logfiles"):
-        logfiles = os.getenv("logfiles").strip().split(" ")  # reference CLI
+        logfiles = os.getenv("logfiles").split()  # reference CLI surface
     if logfiles:
         compute_acc(
             logfiles,

@@ -8,10 +8,14 @@ GCN convolution is expressed with ``jax.ops.segment_sum`` over a static-shape
 scatter/gather — no sparse-matrix library needed, and masked edges make
 subgraph pruning a weight change instead of a shape change (SPMD-friendly).
 
-Each model exposes ``embed`` (first message-passing layer) and ``head`` (the
-rest) so federated boundary-embedding exchange can be injected between the
-layers — the functional analogue of the reference's forward-pre-hooks on
-``MessagePassing`` modules (``graph_worker.py:344-373``).
+Each model exposes a **stage API** so federated boundary-embedding exchange
+can be injected before every message-passing layer after the first — the
+functional analogue of the reference's forward-pre-hooks on EVERY
+``MessagePassing`` module with index > 0 (``graph_worker.py:344-373``):
+``num_mp_layers`` counts the message-passing layers, and
+``mp_stage(i, h, inputs, train)`` runs one of them (stage 0 reads
+``inputs["x"]``; the final stage ends in logits).  ``embed``/``head`` remain
+as the two-stage view (stage 0 / all remaining stages, no exchange).
 """
 
 import flax.linen as nn
@@ -48,45 +52,85 @@ class GCNLayer(nn.Module):
         return out + bias
 
 
-class TwoGCN(nn.Module):
+class _StagedGCN(nn.Module):
+    """Shared stage plumbing: subclasses define ``num_mp_layers`` and
+    ``mp_stage``; ``embed``/``head``/``__call__`` derive from them."""
+
+    def embed(self, inputs, train: bool = False):
+        return self.mp_stage(0, None, inputs, train=train)
+
+    def head(self, h, inputs, train: bool = False):
+        for i in range(1, self.num_mp_layers):
+            h = self.mp_stage(i, h, inputs, train=train)
+        return h
+
+    def __call__(self, inputs, train: bool = False):
+        return self.head(self.embed(inputs, train=train), inputs, train=train)
+
+
+class TwoGCN(_StagedGCN):
     num_classes: int
     hidden: int = 64
     dropout_rate: float = 0.5
+    num_mp_layers: int = 2
 
     def setup(self) -> None:
         self.conv1 = GCNLayer(self.hidden)
         self.conv2 = GCNLayer(self.num_classes)
         self.dropout = nn.Dropout(self.dropout_rate)
 
-    def embed(self, inputs, train: bool = False):
-        x = self.conv1(inputs["x"], inputs["edge_index"], inputs.get("edge_mask"))
-        return nn.relu(x)
-
-    def head(self, h, inputs, train: bool = False):
+    def mp_stage(self, i: int, h, inputs, train: bool = False):
+        if i == 0:
+            x = self.conv1(
+                inputs["x"], inputs["edge_index"], inputs.get("edge_mask")
+            )
+            return nn.relu(x)
         h = self.dropout(h, deterministic=not train)
         return self.conv2(h, inputs["edge_index"], inputs.get("edge_mask"))
 
-    def __call__(self, inputs, train: bool = False):
-        return self.head(self.embed(inputs, train=train), inputs, train=train)
 
+class ThreeGCN(_StagedGCN):
+    """Three message-passing layers — exchanges fire before layers 2 AND 3
+    (the depth the reference's per-layer hooks handle and a two-stage
+    embed/head split silently would not)."""
 
-class SimpleGCN(nn.Module):
     num_classes: int
     hidden: int = 64
+    dropout_rate: float = 0.5
+    num_mp_layers: int = 3
+
+    def setup(self) -> None:
+        self.conv1 = GCNLayer(self.hidden)
+        self.conv2 = GCNLayer(self.hidden)
+        self.conv3 = GCNLayer(self.num_classes)
+        self.dropout = nn.Dropout(self.dropout_rate)
+
+    def mp_stage(self, i: int, h, inputs, train: bool = False):
+        edge_index, edge_mask = inputs["edge_index"], inputs.get("edge_mask")
+        if i == 0:
+            return nn.relu(self.conv1(inputs["x"], edge_index, edge_mask))
+        h = self.dropout(h, deterministic=not train)
+        if i == 1:
+            return nn.relu(self.conv2(h, edge_index, edge_mask))
+        return self.conv3(h, edge_index, edge_mask)
+
+
+class SimpleGCN(_StagedGCN):
+    num_classes: int
+    hidden: int = 64
+    num_mp_layers: int = 2  # dense head kept as a stage for exchange parity
 
     def setup(self) -> None:
         self.conv1 = GCNLayer(self.hidden)
         self.out = nn.Dense(self.num_classes)
 
-    def embed(self, inputs, train: bool = False):
-        x = self.conv1(inputs["x"], inputs["edge_index"], inputs.get("edge_mask"))
-        return nn.relu(x)
-
-    def head(self, h, inputs, train: bool = False):
+    def mp_stage(self, i: int, h, inputs, train: bool = False):
+        if i == 0:
+            x = self.conv1(
+                inputs["x"], inputs["edge_index"], inputs.get("edge_mask")
+            )
+            return nn.relu(x)
         return self.out(h)
-
-    def __call__(self, inputs, train: bool = False):
-        return self.head(self.embed(inputs, train=train), inputs, train=train)
 
 
 class OneGCN(SimpleGCN):
@@ -113,6 +157,13 @@ def _graph_context(name: str, module, dataset_collection) -> ModelContext:
 def _two_gcn(dataset_collection, hidden: int = 64, **kwargs) -> ModelContext:
     return _graph_context(
         "TwoGCN", TwoGCN(dataset_collection.num_classes, hidden), dataset_collection
+    )
+
+
+@register_model("ThreeGCN", "threegcn")
+def _three_gcn(dataset_collection, hidden: int = 64, **kwargs) -> ModelContext:
+    return _graph_context(
+        "ThreeGCN", ThreeGCN(dataset_collection.num_classes, hidden), dataset_collection
     )
 
 

@@ -133,9 +133,10 @@ class SpmdFedGNNSession:
 
         self._dataset_sizes = sizes
         hidden = int(getattr(self.model_ctx.module, "hidden", 64))
-        steps = config.epoch  # full-batch: one exchange per epoch
+        boundaries = int(getattr(self.model_ctx.module, "num_mp_layers", 2)) - 1
+        steps = config.epoch  # full-batch: one exchange set per epoch
         self._round_payload_bytes = int(
-            steps * 4 * hidden * (provide_mask.sum() + recv_mask.sum())
+            steps * boundaries * 4 * hidden * (provide_mask.sum() + recv_mask.sum())
         )
         if not self._share_feature:
             cross_edges = local_edges.copy()
@@ -166,26 +167,24 @@ class SpmdFedGNNSession:
         model = self.model_ctx.module
         epochs = self.config.epoch
         share_feature = self._share_feature
+        num_layers = int(getattr(model, "num_mp_layers", 2))
 
-        def apply_embed(params, inputs, train, rng):
+        def apply_stage(params, i, h, inputs, train, rng=None):
             variables = {"params": unflatten_nested(params)}
+            # fold the stage index in: each apply restarts the rng counter,
+            # so an unfolded key would repeat one dropout mask across stages
             return model.apply(
                 variables,
-                inputs,
-                train=train,
-                method=model.embed,
-                rngs={"dropout": rng} if train else None,
-            )
-
-        def apply_head(params, h, inputs, rng):
-            variables = {"params": unflatten_nested(params)}
-            return model.apply(
-                variables,
+                i,
                 h,
                 inputs,
-                train=True,
-                method=model.head,
-                rngs={"dropout": rng},
+                train=train,
+                method=model.mp_stage,
+                rngs=(
+                    {"dropout": jax.random.fold_in(rng, i)}
+                    if rng is not None
+                    else None
+                ),
             )
 
         def round_program(global_params, weights, rngs, data):
@@ -210,35 +209,48 @@ class SpmdFedGNNSession:
                 def epoch_body(carry, epoch_rngs):
                     params_s, opt_s = carry
                     if share_feature:
-                        # the reference's through-server exchange, as one
-                        # collective: disjoint owner masks sum into a global
-                        # embedding table
+                        # the reference's through-server barrier before each
+                        # MessagePassing layer after the first, one psum per
+                        # layer boundary: disjoint owner masks sum into a
+                        # global embedding table per boundary
+                        tables = []
                         h_pay = jax.vmap(
-                            lambda p, lm: apply_embed(
-                                p, inputs_for(lm), False, None
+                            lambda p, lm: apply_stage(
+                                p, 0, None, inputs_for(lm), False
                             )
                         )(params_s, data["local_edges"])
-                        provide_sum = jnp.einsum(
-                            "sn,snh->nh", data["provide"], h_pay
-                        )
-                        table = jax.lax.stop_gradient(
-                            jax.lax.psum(provide_sum, axis_name="clients")
-                        )
+                        for i in range(1, num_layers):
+                            provide_sum = jnp.einsum(
+                                "sn,snh->nh", data["provide"], h_pay
+                            )
+                            table = jax.lax.stop_gradient(
+                                jax.lax.psum(provide_sum, axis_name="clients")
+                            )
+                            tables.append(table)
+                            if i < num_layers - 1:
+                                h_mixed = (
+                                    h_pay * (1.0 - data["recv"])[..., None]
+                                    + table[None] * data["recv"][..., None]
+                                )
+                                h_pay = jax.vmap(
+                                    lambda p, h, cm, i=i: apply_stage(
+                                        p, i, h, inputs_for(cm), False
+                                    )
+                                )(params_s, h_mixed, data["cross_edges"])
                     else:
-                        table = None
+                        tables = None
 
                     def slot_step(p, o, lm, cm, rm, tm, rng):
                         def loss_fn(p):
-                            h_local = apply_embed(p, inputs_for(lm), True, rng)
-                            if table is not None:
-                                h = (
-                                    h_local * (1.0 - rm[:, None])
-                                    + table * rm[:, None]
-                                )
-                            else:
-                                h = h_local
-                            logits = apply_head(p, h, inputs_for(cm), rng)
-                            return masked_ce_loss(logits, targets, tm)
+                            h = apply_stage(p, 0, None, inputs_for(lm), True, rng)
+                            for i in range(1, num_layers):
+                                if tables is not None:
+                                    h = (
+                                        h * (1.0 - rm[:, None])
+                                        + tables[i - 1] * rm[:, None]
+                                    )
+                                h = apply_stage(p, i, h, inputs_for(cm), True, rng)
+                            return masked_ce_loss(h, targets, tm)
 
                         (loss, aux), grads = jax.value_and_grad(
                             loss_fn, has_aux=True

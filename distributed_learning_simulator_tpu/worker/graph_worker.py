@@ -9,10 +9,11 @@ TPU-native equivalent of ``simulation_lib/worker/graph_worker.py:18-406``:
   pruning here is an **edge mask**, not an edge-list rebuild, so the XLA
   program keeps static shapes;
 * with ``share_feature``, every training step performs a synchronous
-  boundary-embedding exchange through the server between the first and
-  second message-passing layers (reference installs forward-pre-hooks,
-  ``graph_worker.py:344-373``; here the model's ``embed``/``head`` stages are
-  called explicitly and received rows enter as constants —
+  boundary-embedding exchange through the server before EVERY
+  message-passing layer after the first (reference installs
+  forward-pre-hooks on each ``MessagePassing`` module with index > 0,
+  ``graph_worker.py:344-373``; here the model's ``mp_stage`` API is called
+  explicitly per layer and received rows enter as constants —
   ``stop_gradient`` — matching the reference's detached pipe tensors);
 * tracks communicated/skipped bytes and edge/node counts, dumped to
   ``graph_worker_stat.json`` (reference ``graph_worker.py:391-406``).
@@ -132,18 +133,11 @@ class GraphWorker(AggregationWorker):
         )
 
     # ----------------------------------------------------- per-step exchange
-    def _shared_feature_step(self, executor, batch, step_rng, **kwargs) -> None:
-        trainer = executor
-        params = trainer.params
-        model = trainer.model_ctx.module
-        variables = {"params": unflatten_nested(params)}
-        inputs_local = dict(batch["input"])
-        inputs_local["edge_mask"] = jnp.asarray(self._local_edge_mask)
-        inputs_cross = dict(batch["input"])
-        inputs_cross["edge_mask"] = jnp.asarray(self._cross_edge_mask)
-
-        h = model.apply(variables, inputs_local, train=False, method=model.embed)
-
+    def _exchange_boundary_rows(self, h) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """One synchronous through-server boundary-embedding exchange (the
+        reference's ``_pass_node_feature`` barrier) for the current layer
+        activations ``h``.  Returns ``(h_received, received_mask)``, both
+        detached (the reference's pipe tensors carry no grad)."""
         payload = {
             "node_embedding": np.asarray(h[self._provide_nodes]),
             "node_indices": self._provide_nodes,
@@ -162,25 +156,66 @@ class GraphWorker(AggregationWorker):
         if len(received_ids):
             h_received = h_received.at[received_ids].set(jnp.asarray(received))
             received_mask = received_mask.at[received_ids].set(1.0)
-        h_received = jax.lax.stop_gradient(h_received)
-        received_mask = jax.lax.stop_gradient(received_mask)
+        return (
+            jax.lax.stop_gradient(h_received),
+            jax.lax.stop_gradient(received_mask),
+        )
+
+    def _shared_feature_step(self, executor, batch, step_rng, **kwargs) -> None:
+        """One optimizer step with a boundary exchange before EVERY
+        message-passing layer after the first (reference installs a
+        forward-pre-hook on each ``MessagePassing`` module with index > 0,
+        ``graph_worker.py:344-373``) — ``num_mp_layers - 1`` synchronous
+        barriers per step, not one."""
+        trainer = executor
+        params = trainer.params
+        model = trainer.model_ctx.module
+        num_layers = int(getattr(model, "num_mp_layers", 2))
+        variables = {"params": unflatten_nested(params)}
+        inputs_local = dict(batch["input"])
+        inputs_local["edge_mask"] = jnp.asarray(self._local_edge_mask)
+        inputs_cross = dict(batch["input"])
+        inputs_cross["edge_mask"] = jnp.asarray(self._cross_edge_mask)
+
+        def stage(vs, i, h, inputs, train, rng=None):
+            # fold the stage index in: each flax apply restarts the rng
+            # counter, so an unfolded key would repeat the SAME dropout
+            # mask at every stage (unlike the un-staged __call__)
+            return model.apply(
+                vs,
+                i,
+                h,
+                inputs,
+                train=train,
+                method=model.mp_stage,
+                rngs=(
+                    {"dropout": jax.random.fold_in(rng, i)}
+                    if rng is not None
+                    else None
+                ),
+            )
+
+        # payload forward (eval mode): exchange at each layer boundary,
+        # collecting the received rows to replay inside the grad pass
+        received_per_layer: list[tuple[jnp.ndarray, jnp.ndarray]] = []
+        h = stage(variables, 0, None, inputs_local, False)
+        for i in range(1, num_layers):
+            h_received, received_mask = self._exchange_boundary_rows(h)
+            received_per_layer.append((h_received, received_mask))
+            if i < num_layers - 1:  # the final stage's output feeds no exchange
+                h = h * (1.0 - received_mask) + h_received * received_mask
+                h = stage(variables, i, h, inputs_cross, False)
 
         def loss_fn(p):
             vs = {"params": unflatten_nested(p)}
-            h_local = model.apply(vs, inputs_local, train=True, method=model.embed,
-                                  rngs={"dropout": step_rng})
-            h_mix = h_local * (1.0 - received_mask) + h_received * received_mask
-            logits = model.apply(
-                vs,
-                h_mix,
-                inputs_cross,
-                train=True,
-                method=model.head,
-                rngs={"dropout": step_rng},
-            )
+            h = stage(vs, 0, None, inputs_local, True, step_rng)
+            for i in range(1, num_layers):
+                h_received, received_mask = received_per_layer[i - 1]
+                h = h * (1.0 - received_mask) + h_received * received_mask
+                h = stage(vs, i, h, inputs_cross, True, step_rng)
             from ..models.registry import masked_ce_loss
 
-            loss, aux = masked_ce_loss(logits, batch["target"], batch["mask"])
+            loss, aux = masked_ce_loss(h, batch["target"], batch["mask"])
             return loss, aux
 
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
