@@ -25,6 +25,25 @@ import jax.numpy as jnp
 from .registry import ModelContext, register_model
 
 
+def apply_mp_stage(model, variables, i: int, h, inputs, train: bool, rng=None):
+    """Run one message-passing stage — the ONE dropout-key scheme both
+    executors share: the stage index is folded into the key because each
+    flax ``apply`` restarts the rng counter, so an unfolded key would repeat
+    the same dropout mask at every stage (unlike the un-staged
+    ``__call__``)."""
+    import jax
+
+    return model.apply(
+        variables,
+        i,
+        h,
+        inputs,
+        train=train,
+        method=model.mp_stage,
+        rngs={"dropout": jax.random.fold_in(rng, i)} if rng is not None else None,
+    )
+
+
 def gcn_conv(x, edge_index, edge_mask, weight_fn, num_nodes: int):
     """Symmetric-normalized GCN aggregation with self-loops; ``weight_fn``
     is the dense transform applied before propagation."""

@@ -35,8 +35,10 @@ def initialize_multihost(
     reference's NCCL/MPI bring-up, except the reference never had one (its
     backend is single-host pipes — SURVEY.md §5): collectives ride ICI/DCN
     via the mesh, not a side channel.  Idempotent."""
-    if jax.process_count() > 1:
-        return  # already initialized
+    # NOT jax.process_count(): that would touch the backend, and
+    # jax.distributed.initialize() must run before backend init
+    if jax.distributed.is_initialized():
+        return  # already joined
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -44,7 +46,15 @@ def initialize_multihost(
             process_id=process_id,
         )
     except (ValueError, RuntimeError):
-        # single-process run (no coordinator configured) — nothing to join
+        if (
+            coordinator_address is not None
+            or num_processes is not None
+            or process_id is not None
+        ):
+            # the caller asked for a specific cluster — failing to join it
+            # is an error, not a single-process fallback
+            raise
+        # bare call with no coordinator configured: single-process run
         pass
 
 

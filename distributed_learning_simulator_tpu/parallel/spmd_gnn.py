@@ -169,23 +169,11 @@ class SpmdFedGNNSession:
         share_feature = self._share_feature
         num_layers = int(getattr(model, "num_mp_layers", 2))
 
+        from ..models.graph import apply_mp_stage
+
         def apply_stage(params, i, h, inputs, train, rng=None):
             variables = {"params": unflatten_nested(params)}
-            # fold the stage index in: each apply restarts the rng counter,
-            # so an unfolded key would repeat one dropout mask across stages
-            return model.apply(
-                variables,
-                i,
-                h,
-                inputs,
-                train=train,
-                method=model.mp_stage,
-                rngs=(
-                    {"dropout": jax.random.fold_in(rng, i)}
-                    if rng is not None
-                    else None
-                ),
-            )
+            return apply_mp_stage(model, variables, i, h, inputs, train, rng)
 
         def round_program(global_params, weights, rngs, data):
             def shard_body(global_params, data, weights, rngs):

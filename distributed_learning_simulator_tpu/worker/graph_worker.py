@@ -177,23 +177,10 @@ class GraphWorker(AggregationWorker):
         inputs_cross = dict(batch["input"])
         inputs_cross["edge_mask"] = jnp.asarray(self._cross_edge_mask)
 
+        from ..models.graph import apply_mp_stage
+
         def stage(vs, i, h, inputs, train, rng=None):
-            # fold the stage index in: each flax apply restarts the rng
-            # counter, so an unfolded key would repeat the SAME dropout
-            # mask at every stage (unlike the un-staged __call__)
-            return model.apply(
-                vs,
-                i,
-                h,
-                inputs,
-                train=train,
-                method=model.mp_stage,
-                rngs=(
-                    {"dropout": jax.random.fold_in(rng, i)}
-                    if rng is not None
-                    else None
-                ),
-            )
+            return apply_mp_stage(model, vs, i, h, inputs, train, rng)
 
         # payload forward (eval mode): exchange at each layer boundary,
         # collecting the received rows to replay inside the grad pass

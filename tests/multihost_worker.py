@@ -1,0 +1,82 @@
+"""Subprocess body for the 2-process DCN dryrun (run by
+``test_multihost.py``, not by pytest directly): joins a 2-process
+jax.distributed CPU cluster through ``initialize_multihost``, builds the
+global mesh, and drives ONE full SPMD FedAvg round with client data placed
+via ``put_sharded`` across process boundaries."""
+
+import os
+import sys
+
+
+def main() -> int:
+    process_id = int(sys.argv[1])
+    num_processes = int(sys.argv[2])
+    coordinator = sys.argv[3]
+    save_dir = sys.argv[4]
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""  # keep the axon platform out
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from distributed_learning_simulator_tpu.parallel.mesh import (
+        initialize_multihost,
+        make_mesh,
+    )
+
+    initialize_multihost(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    assert jax.process_count() == num_processes, jax.process_count()
+    assert len(jax.devices()) == 4 * num_processes
+    assert len(jax.local_devices()) == 4
+
+    from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+    from distributed_learning_simulator_tpu.engine.engine import ComputeEngine
+    from distributed_learning_simulator_tpu.engine.hyper_parameter import (
+        HyperParameter,
+    )
+    from distributed_learning_simulator_tpu.data import create_dataset_collection
+    from distributed_learning_simulator_tpu.models import create_model_context
+    from distributed_learning_simulator_tpu.parallel.spmd import SpmdFedAvgSession
+
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        distributed_algorithm="fed_avg",
+        worker_number=8,
+        batch_size=16,
+        round=1,
+        epoch=1,
+        learning_rate=0.05,
+        dataset_kwargs={"train_size": 128, "val_size": 16, "test_size": 32},
+        # per-process save dirs: the dryrun asserts the compute path, not
+        # shared-filesystem artifact coordination
+        save_dir=os.path.join(save_dir, f"proc{process_id}"),
+        log_file="",
+        checkpoint_every_round=False,
+    )
+    practitioners = config.create_practitioners()
+    dataset_collection = create_dataset_collection(config)
+    model_ctx = create_model_context(config.model_name, dataset_collection)
+    engine = ComputeEngine(
+        model_ctx, HyperParameter.from_config(config), total_steps=8
+    )
+    mesh = make_mesh()  # spans the global 8 devices of the 2-process cluster
+    assert mesh.devices.size == 8
+    session = SpmdFedAvgSession(
+        config, dataset_collection, model_ctx, engine, practitioners, mesh=mesh
+    )
+    result = session.run()
+    stat = result["performance"][1]
+    assert 0.0 <= stat["test_accuracy"] <= 1.0, stat
+    print(f"MULTIHOST_OK {process_id} acc={stat['test_accuracy']:.4f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,63 @@
+"""2-process DCN dryrun (VERDICT r1 item 7): ``initialize_multihost`` +
+``put_sharded`` must construct and run a real SPMD FedAvg round across
+process boundaries — the CPU stand-in for a multi-host TPU pod (each
+process contributes 4 virtual devices; collectives cross the process
+boundary via the distributed runtime the way DCN traffic would)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO_ROOT, "tests", "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("localhost", 0))
+        return sock.getsockname()[1]
+
+
+def test_two_process_fed_avg_round(tmp_path):
+    coordinator = f"localhost:{_free_port()}"
+    env = {
+        **os.environ,
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), "2", coordinator, str(tmp_path)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=REPO_ROOT,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outputs = []
+    try:
+        for proc in procs:
+            out, _ = proc.communicate(timeout=540)
+            outputs.append(out)
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+    for i, (proc, out) in enumerate(zip(procs, outputs)):
+        tail = "\n".join(out.splitlines()[-25:])
+        assert proc.returncode == 0, f"process {i} failed:\n{tail}"
+        assert f"MULTIHOST_OK {i}" in out, f"process {i} missing marker:\n{tail}"
+    # both processes computed the SAME round (one SPMD program over the
+    # shared mesh): their reported accuracies must agree exactly
+    accs = sorted(
+        line.split("acc=")[1]
+        for out in outputs
+        for line in out.splitlines()
+        if "MULTIHOST_OK" in line
+    )
+    assert len(accs) == 2 and accs[0] == accs[1], accs
