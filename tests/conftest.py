@@ -26,3 +26,25 @@ import pytest  # noqa: E402
 def tmp_session_dir(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     return tmp_path
+
+
+def fed_avg_config(**overrides):
+    """Shared tiny MNIST/LeNet5 fed_avg config factory (one definition for
+    the e2e/resume/fault suites; override what the test cares about)."""
+    from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        distributed_algorithm="fed_avg",
+        optimizer_name="SGD",
+        worker_number=2,
+        batch_size=32,
+        round=2,
+        epoch=1,
+        learning_rate=0.05,
+        dataset_kwargs={"train_size": 128, "val_size": 32, "test_size": 32},
+    )
+    for key, value in overrides.items():
+        setattr(config, key, value)
+    return config

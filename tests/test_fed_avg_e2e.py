@@ -4,29 +4,20 @@
 import json
 import os
 
-from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+from conftest import fed_avg_config
 from distributed_learning_simulator_tpu.training import train
 
 
-def make_config(**overrides) -> DistributedTrainingConfig:
-    config = DistributedTrainingConfig(
-        dataset_name="MNIST",
-        model_name="LeNet5",
-        distributed_algorithm="fed_avg",
-        # reference-parity e2e: the threaded executor (SPMD e2e lives in
-        # test_spmd*.py / test_executor_matrix.py)
+def make_config(**overrides):
+    # reference-parity e2e: the threaded executor (SPMD e2e lives in
+    # test_spmd*.py / test_executor_matrix.py)
+    base = dict(
         executor="sequential",
-        optimizer_name="SGD",
-        worker_number=2,
-        batch_size=32,
         round=1,
-        epoch=1,
-        learning_rate=0.05,
         dataset_kwargs={"train_size": 256, "val_size": 64, "test_size": 64},
     )
-    for key, value in overrides.items():
-        setattr(config, key, value)
-    return config
+    base.update(overrides)
+    return fed_avg_config(**base)
 
 
 def test_fed_avg_end_to_end(tmp_session_dir):

@@ -4,25 +4,8 @@ lacks — SURVEY.md §5 "a killed run restarts from round 1")."""
 
 import os
 
-from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+from conftest import fed_avg_config as _config
 from distributed_learning_simulator_tpu.training import train
-
-
-def _config(**overrides):
-    config = DistributedTrainingConfig(
-        dataset_name="MNIST",
-        model_name="LeNet5",
-        distributed_algorithm="fed_avg",
-        worker_number=2,
-        batch_size=32,
-        round=2,
-        epoch=1,
-        learning_rate=0.05,
-        dataset_kwargs={"train_size": 128, "val_size": 32, "test_size": 32},
-    )
-    for key, value in overrides.items():
-        setattr(config, key, value)
-    return config
 
 
 def test_resume_from_previous_session(tmp_session_dir):
