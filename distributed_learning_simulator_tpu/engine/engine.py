@@ -35,6 +35,10 @@ class ComputeEngine:
         self.total_steps = max(1, total_steps)
         self.optimizer = hyper_parameter.make_optimizer(self.total_steps)
         self.schedule = hyper_parameter.make_schedule(self.total_steps)
+        # rematerialization for large client models (ViT/BERT-scale):
+        # trade recompute for activation memory — the standard TPU lever
+        # when HBM, not FLOPs, binds (extra_hyper_parameters: {remat: true})
+        self.use_remat = bool(hyper_parameter.extra.get("remat", False))
 
     # ---- pure functions (also used by the SPMD executor under vmap/shard_map)
 
@@ -45,9 +49,17 @@ class ComputeEngine:
         return self.optimizer.init(params)
 
     def loss_and_grad(self, params: Params, batch: dict, rng):
-        return jax.value_and_grad(self.model_ctx.loss, has_aux=True)(
-            params, batch, train=True, rngs={"dropout": rng} if rng is not None else None
-        )
+        def loss_call(params, batch, rng):
+            return self.model_ctx.loss(
+                params,
+                batch,
+                train=True,
+                rngs={"dropout": rng} if rng is not None else None,
+            )
+
+        if self.use_remat:
+            loss_call = jax.checkpoint(loss_call)
+        return jax.value_and_grad(loss_call, has_aux=True)(params, batch, rng)
 
     def train_step_fn(self, params, opt_state, batch, rng):
         (loss, aux), grads = self.loss_and_grad(params, batch, rng)

@@ -172,3 +172,51 @@ def test_slow_performance_metrics(tmp_path):
         counts = np.bincount(test_targets, minlength=10)
         weighted = float(np.dot(per_class, counts) / counts.sum())
         assert abs(weighted - stat["test_accuracy"]) < 1e-4
+
+
+def test_remat_matches_plain_gradients():
+    """extra_hyper_parameters: {remat: true} trades recompute for activation
+    memory without changing the numerics (jax.checkpoint recomputes the
+    identical forward)."""
+    import jax
+    import numpy as np
+
+    from distributed_learning_simulator_tpu.data.registry import (
+        global_dataset_factory,
+    )
+    from distributed_learning_simulator_tpu.engine.engine import ComputeEngine
+    from distributed_learning_simulator_tpu.engine.hyper_parameter import (
+        HyperParameter,
+    )
+    from distributed_learning_simulator_tpu.ml_type import (
+        MachineLearningPhase as Phase,
+    )
+    from distributed_learning_simulator_tpu.models.registry import (
+        create_model_context,
+    )
+
+    dc = global_dataset_factory["MNIST"](train_size=32)
+    ctx = create_model_context("LeNet5", dc)
+    train = dc.get_dataset(Phase.Training)
+    batch = {
+        "input": np.asarray(train.inputs[:8], np.float32),
+        "target": np.asarray(train.targets[:8]),
+        "mask": np.ones(8, np.float32),
+    }
+
+    def grads_for(extra):
+        hp = HyperParameter(
+            epoch=1, batch_size=8, learning_rate=0.1, extra=extra
+        )
+        engine = ComputeEngine(ctx, hp, total_steps=1)
+        assert engine.use_remat == bool(extra.get("remat", False))
+        params = engine.init_params(0)
+        (_, _), grads = engine.loss_and_grad(params, batch, jax.random.PRNGKey(1))
+        return grads
+
+    plain = grads_for({})
+    remat = grads_for({"remat": True})
+    for key in plain:
+        np.testing.assert_allclose(
+            np.asarray(plain[key]), np.asarray(remat[key]), atol=1e-6
+        )
