@@ -43,7 +43,7 @@ class DistributedTrainingConfig:
     # --- federated fields (reference config.py:16-35) ---
     distributed_algorithm: str = ""
     worker_number: int = 1
-    parallel_number: int = 0  # 0 -> number of local devices
+    parallel_number: int = 0  # threaded executor: max concurrent local training loops (0 = unbounded)
     round: int = 1
     dataset_sampling: str = "iid"
     dataset_sampling_kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)

@@ -54,5 +54,12 @@ class Executor:
     def _get_execution_context(self) -> ExecutorContext:
         return ExecutorContext(self._name)
 
+    def _raise_if_aborted(self) -> None:
+        """One definition of the abort check used by every blocking loop."""
+        if self._task_context is not None and self._task_context.aborted():
+            from .ml_type import TaskAbortedError
+
+            raise TaskAbortedError(self._name)
+
     def start(self) -> None:
         raise NotImplementedError

@@ -50,6 +50,9 @@ class TaskContext:
     practitioners: list = dataclasses.field(default_factory=list)
     timer: TimeCounter = dataclasses.field(default_factory=TimeCounter)
     spmd_result: Any = None  # set by the SPMD session thread (task mode)
+    # reference parallel_number: at most this many concurrent local
+    # training loops on the threaded executor (None = unbounded)
+    train_slots: Any = None
 
     def aborted(self) -> bool:
         return self.abort_event.is_set()
@@ -109,6 +112,15 @@ def _build_task(
         model_ctx, hyper_parameter, total_steps=steps_per_epoch * config.epoch
     )
     topology = CentralTopology(config.worker_number)
+    # reference ``parallel_number`` (worker processes per group,
+    # ``algorithm_factory.py:38-58``) → bounded concurrent training loops
+    # on the threaded executor; 0 keeps today's unbounded default (XLA
+    # already serializes device work — the bound caps host-side staging)
+    train_slots = (
+        threading.BoundedSemaphore(config.parallel_number)
+        if config.parallel_number > 0
+        else None
+    )
     return TaskContext(
         config=config,
         dataset_collection=dataset_collection,
@@ -117,6 +129,7 @@ def _build_task(
         topology=topology,
         task_id=task_id,
         practitioners=practitioners,
+        train_slots=train_slots,
     )
 
 

@@ -18,12 +18,26 @@ class Client(Worker):
     def _get_data_from_server(self) -> Any:
         import queue
 
+        # while blocked on the server, hand the training slot to a peer
+        # (reference: the device lock is released during the poll loop,
+        # ``worker/client.py:13-22``) — with parallel_number < worker_number
+        # the server's all-N barrier would otherwise deadlock
+        owed_slot = self._holds_slot or self._slot_deferred
+        if self._holds_slot:
+            self._release_slot()
         while True:
-            if self._task_context is not None and self._task_context.aborted():
-                from ..ml_type import TaskAbortedError
-
-                raise TaskAbortedError(self.name)
+            self._raise_if_aborted()
             try:
-                return self._endpoint.get(timeout=0.5)
+                result = self._endpoint.get(timeout=0.5)
+                break
             except queue.Empty:
                 continue
+        if owed_slot:
+            if result is None:
+                # unselected this round: the None ack needs no compute —
+                # stay slotless and re-acquire when real work arrives
+                self._slot_deferred = True
+            else:
+                self._slot_deferred = False
+                self._acquire_slot()
+        return result

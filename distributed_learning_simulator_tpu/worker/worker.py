@@ -39,6 +39,8 @@ class Worker(Executor):
         self._endpoint = endpoint
         self._round_num = 0
         self._force_stop = False
+        self._holds_slot = False
+        self._slot_deferred = False  # slot owed after an unselected round
 
     @property
     def worker_id(self) -> int:
@@ -92,21 +94,49 @@ class Worker(Executor):
     def _stopped(self) -> bool:
         return self._round_num > self.config.round or self._force_stop
 
+    # ---- train-slot bounding (reference ``parallel_number``) ----
+    # The reference round-robins workers into ``parallel_number`` processes
+    # and serializes within each (``algorithm_factory.py:38-58``); the
+    # analogue here is a semaphore of ``parallel_number`` concurrent local
+    # training loops, released while a worker blocks on the server (the
+    # reference's Client releases its device lock the same way,
+    # ``worker/client.py:13-22``).  0 = unbounded.
+    def _train_slots(self):
+        return getattr(self._task_context, "train_slots", None)
+
+    def _acquire_slot(self) -> None:
+        slots = self._train_slots()
+        if slots is None or self._holds_slot:
+            return
+        while not slots.acquire(timeout=0.5):
+            self._raise_if_aborted()
+        self._holds_slot = True
+
+    def _release_slot(self) -> None:
+        slots = self._train_slots()
+        if slots is not None and self._holds_slot:
+            self._holds_slot = False
+            slots.release()
+
     def start(self, **kwargs: Any) -> None:
         first_training = True
         self._round_num = 1
         self._force_stop = False
         with self._get_execution_context():
-            while not self._stopped():
-                if first_training:
-                    self._before_training()
-                    first_training = False
-                    if self._stopped():
-                        break
-                self.trainer.set_visualizer_prefix(f"round: {self._round_num},")
-                self._before_round()
-                self.trainer.train(**kwargs)
-                self._round_num += 1
+            try:
+                while not self._stopped():
+                    if first_training:
+                        self._before_training()
+                        first_training = False
+                        if self._stopped():
+                            break
+                    self.trainer.set_visualizer_prefix(f"round: {self._round_num},")
+                    self._before_round()
+                    self._acquire_slot()
+                    self.trainer.train(**kwargs)
+                    self._round_num += 1
+            finally:
+                self._release_slot()
             get_logger().debug("finish %s", self.name)
             self._endpoint.close()
             self._after_training()

@@ -63,3 +63,60 @@ def test_concurrent_spmd_tasks(tmp_session_dir):
     for task_id in task_ids:
         result = get_training_result(task_id)
         assert result["performance"][1]["test_count"] == 32.0
+
+
+def test_parallel_number_bounds_concurrent_training(tmp_session_dir):
+    """reference parallel_number semantics on the threaded executor: at most
+    N workers run the epoch compute concurrently; the slot is released while
+    a worker blocks on the server, so the all-worker barrier completes."""
+    import threading
+
+    from conftest import fed_avg_config
+    from distributed_learning_simulator_tpu import training
+
+    config = fed_avg_config(
+        executor="sequential", worker_number=4, parallel_number=1
+    )
+    config.load_config_and_process()
+    ctx = training._build_task(config)
+    assert ctx.train_slots is not None
+
+    state = {"current": 0, "peak": 0}
+    lock = threading.Lock()
+    original = ctx.engine.train_epoch  # cached_property -> instance value
+
+    def tracked(*args, **kwargs):
+        with lock:
+            state["current"] += 1
+            state["peak"] = max(state["peak"], state["current"])
+        try:
+            return original(*args, **kwargs)
+        finally:
+            with lock:
+                state["current"] -= 1
+
+    ctx.engine.__dict__["train_epoch"] = tracked
+    training._spawn(ctx)
+    result = training._harvest(ctx)
+    assert len(result["performance"]) == 2
+    # only one worker at a time inside the epoch compute
+    assert state["peak"] == 1, state
+
+
+def test_parallel_number_with_unselected_rounds(tmp_session_dir):
+    """The deferred-slot path: unselected workers ack with None while
+    slotless and re-acquire when real work arrives — selection plus a
+    1-slot bound must not deadlock or stall."""
+    from conftest import fed_avg_config
+    from distributed_learning_simulator_tpu.training import train
+
+    result = train(
+        fed_avg_config(
+            executor="sequential",
+            worker_number=3,
+            parallel_number=1,
+            round=3,
+            algorithm_kwargs={"random_client_number": 2},
+        )
+    )
+    assert len(result["performance"]) == 3
