@@ -55,6 +55,65 @@ def make_graph_batch(dataset: ArrayDataset, phase_mask_key: str = "mask") -> dic
     }
 
 
+def make_graph_minibatches(
+    batch: dict,
+    batch_number: int,
+    num_neighbor: int | None,
+    rng: np.random.Generator,
+) -> dict:
+    """Split a full-graph batch into ``batch_number`` minibatches of training
+    nodes (the reference's graph dataloader semantics:
+    ``simulation_lib/worker/graph_worker.py:94-101`` — per-epoch shuffled
+    near-equal node batches, optional ``num_neighbor`` fan-in sampling).
+
+    The graph stays static-shape: each minibatch is the SAME graph with a
+    different loss ``mask`` (that batch's training nodes) and, when
+    ``num_neighbor`` is set, a per-batch fan-in-capped ``edge_mask``.
+    Batch-invariant leaves are ``np.broadcast_to`` views — no host copies.
+    """
+    from ..ops.graph_sampling import cap_fan_in
+
+    mask = np.asarray(batch["mask"])
+    train_nodes = np.nonzero(mask)[0]
+    order = rng.permutation(train_nodes)
+    # ALWAYS batch_number batches, even if some come out empty: the
+    # share_feature exchange is a synchronous all-worker barrier per batch,
+    # so every worker must run the same batch count (the reference forces
+    # equal counts the same way, graph_worker.py:94-97); an empty batch is a
+    # zero mask (masked_ce_loss guards the 0-count divide)
+    n_batches = max(1, int(batch_number))
+    masks = np.zeros((n_batches, mask.shape[0]), np.float32)
+    for b in range(n_batches):
+        masks[b, order[b::n_batches]] = 1.0
+
+    batch_inputs = dict(batch["input"])
+    if num_neighbor is not None and "edge_mask" not in batch_inputs:
+        batch_inputs["edge_mask"] = np.ones(
+            np.asarray(batch_inputs["edge_index"]).shape[1], np.float32
+        )
+    inputs = {}
+    for key, value in batch_inputs.items():
+        value = np.asarray(value)
+        if key == "edge_mask" and num_neighbor is not None:
+            dst = np.asarray(batch["input"]["edge_index"])[1]
+            capped = np.zeros((n_batches, value.shape[0]), value.dtype)
+            for b in range(n_batches):
+                capped[b] = cap_fan_in(
+                    value.astype(bool), dst, int(num_neighbor), rng
+                )
+            inputs[key] = capped
+        else:
+            inputs[key] = np.broadcast_to(value[None], (n_batches, *value.shape))
+    return {
+        "input": inputs,
+        "target": np.broadcast_to(
+            np.asarray(batch["target"])[None],
+            (n_batches, *np.asarray(batch["target"]).shape),
+        ),
+        "mask": masks,
+    }
+
+
 def fixed_size_partition(indices: np.ndarray, size: int) -> tuple[np.ndarray, np.ndarray]:
     """Pad/truncate an index set to exactly ``size``, returning (indices, mask).
 
@@ -70,4 +129,10 @@ def fixed_size_partition(indices: np.ndarray, size: int) -> tuple[np.ndarray, np
     return np.concatenate([indices, pad]), mask
 
 
-__all__ = ["make_epoch_batches", "make_graph_batch", "fixed_size_partition", "Phase"]
+__all__ = [
+    "make_epoch_batches",
+    "make_graph_batch",
+    "make_graph_minibatches",
+    "fixed_size_partition",
+    "Phase",
+]

@@ -21,7 +21,7 @@ from ..ml_type import ExecutorHookPoint, MachineLearningPhase, StopExecutingExce
 from ..models.registry import ModelContext
 from ..ops.pytree import Params
 from ..utils.logging import get_logger
-from .batching import make_epoch_batches, make_graph_batch
+from .batching import make_epoch_batches, make_graph_batch, make_graph_minibatches
 from .engine import ComputeEngine, maybe_slow_metrics, summarize_metrics
 from .hyper_parameter import HyperParameter
 
@@ -72,6 +72,13 @@ class ExecutorBase:
         self._params: Params | None = None
         self.performance_metric = PerformanceMetric()
         self.visualizer_prefix = ""
+        self._dataloader_kwargs: dict[str, Any] = {}
+
+    def update_dataloader_kwargs(self, **kwargs: Any) -> None:
+        """Reference ``Trainer.update_dataloader_kwargs`` — graph workers
+        push ``batch_number``/``num_neighbor`` through this
+        (``simulation_lib/worker/graph_worker.py:94-101``)."""
+        self._dataloader_kwargs.update(kwargs)
 
     @property
     def hyper_parameter(self) -> HyperParameter:
@@ -114,6 +121,19 @@ class ExecutorBase:
             dataset.inputs, dict
         ):
             batch = make_graph_batch(dataset)
+            batch_number = int(self._dataloader_kwargs.get("batch_number") or 1)
+            num_neighbor = self._dataloader_kwargs.get("num_neighbor")
+            if shuffle_seed is not None and (
+                batch_number > 1 or num_neighbor is not None
+            ):
+                # the reference's graph dataloader: per-epoch shuffled node
+                # minibatches + neighbor sampling (graph_worker.py:94-101)
+                return make_graph_minibatches(
+                    batch,
+                    batch_number,
+                    num_neighbor,
+                    np.random.default_rng(shuffle_seed),
+                )
             return jax.tree.map(lambda x: np.asarray(x)[None], batch)  # 1-batch epoch
         rng = None if shuffle_seed is None else np.random.default_rng(shuffle_seed)
         return make_epoch_batches(dataset, self.hyper_parameter.batch_size, rng)
@@ -189,7 +209,15 @@ class Trainer(ExecutorBase):
                 batches = self._epoch_batches(self.phase, shuffle_seed)
                 self._fire(ExecutorHookPoint.BEFORE_EPOCH, epoch=epoch)
                 self._rng, epoch_rng = jax.random.split(self._rng)
-                if per_step:
+                # graph minibatch epochs stack batch-invariant leaves as
+                # zero-copy broadcast VIEWS; the jitted scan would transfer
+                # them densely (graph × batch_number on device), so step
+                # batch-by-batch instead — each step uploads one graph copy
+                graph_minibatch = (
+                    isinstance(batches["input"], dict)
+                    and batches["target"].shape[0] > 1
+                )
+                if per_step or graph_minibatch:
                     summed = self._train_epoch_per_step(batches, epoch, epoch_rng)
                 else:
                     params, opt_state, summed = self.engine.train_epoch(

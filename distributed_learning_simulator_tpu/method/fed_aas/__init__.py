@@ -14,30 +14,13 @@ the reference's ``num_neighbor`` dataloader kwarg,
 
 import numpy as np
 
+from ...ops.graph_sampling import cap_fan_in
 from ...server.graph_server import GraphNodeServer
 from ...utils.logging import get_logger
 from ...worker.graph_worker import GraphWorker
 from ..algorithm_factory import CentralizedAlgorithmFactory
 
-
-def cap_fan_in(
-    base_mask: np.ndarray, dst: np.ndarray, limit: int, rng
-) -> np.ndarray:
-    """Cap incoming fan-in per destination node at ``limit``: random
-    permutation, stable-sort by destination, keep rank-within-destination
-    < limit (vectorized — edge lists are large).  Shared by the threaded
-    worker and the SPMD session so their RNG streams stay identical."""
-    candidates = rng.permutation(np.nonzero(base_mask)[0])
-    keep = np.zeros_like(base_mask, dtype=bool)
-    if len(candidates):
-        d = dst[candidates]
-        by_dst = np.argsort(d, kind="stable")
-        sorted_d = d[by_dst]
-        first_idx = np.r_[0, np.nonzero(np.diff(sorted_d))[0] + 1]
-        group_id = np.cumsum(np.r_[0, (np.diff(sorted_d) != 0).astype(np.int64)])
-        rank = np.arange(len(sorted_d)) - first_idx[group_id]
-        keep[candidates[by_dst[rank < limit]]] = True
-    return keep
+__all__ = ["FedAASWorker"]
 
 
 class FedAASWorker(GraphWorker):
@@ -45,6 +28,9 @@ class FedAASWorker(GraphWorker):
         super().__init__(**kwargs)
         # local-subgraph training: never exchange boundary embeddings
         self._share_feature = False
+        # num_neighbor is resampled per ROUND here (not per batch) — keep it
+        # out of the dataloader to avoid double sampling
+        self._dataloader_num_neighbor = False
         self._num_neighbor = self.config.algorithm_kwargs.get(
             "num_neighbor",
             self.config.extra_hyper_parameters.get("num_neighbor"),

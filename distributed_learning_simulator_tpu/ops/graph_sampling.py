@@ -1,0 +1,83 @@
+"""Neighbor-sampling primitives shared by both executors.
+
+The reference bounds GNN fan-in through torch_geometric's ``NeighborLoader``
+(``dataloader kwargs`` ``num_neighbor``, applied per sampled minibatch —
+``simulation_lib/worker/graph_worker.py:98-101``).  On TPU the graph keeps a
+static edge list; sampling is an **edge-mask transform**: cap the number of
+active incoming edges per destination node at ``limit``.
+
+Two implementations with identical semantics:
+
+* :func:`cap_fan_in` — numpy, used by the threaded executor's host-side
+  batch assembly (and fed_aas's per-round resampling);
+* :func:`cap_fan_in_jax` — pure jax, O(E log E) sort-based, usable inside a
+  jitted/scanned round program (the SPMD executor caps per minibatch inside
+  the compiled round).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def cap_fan_in(
+    base_mask: np.ndarray, dst: np.ndarray, limit: int, rng
+) -> np.ndarray:
+    """Cap incoming fan-in per destination node at ``limit``: random
+    permutation, stable-sort by destination, keep rank-within-destination
+    < limit (vectorized — edge lists are large)."""
+    candidates = rng.permutation(np.nonzero(base_mask)[0])
+    keep = np.zeros_like(base_mask, dtype=bool)
+    if len(candidates):
+        d = dst[candidates]
+        by_dst = np.argsort(d, kind="stable")
+        sorted_d = d[by_dst]
+        first_idx = np.r_[0, np.nonzero(np.diff(sorted_d))[0] + 1]
+        group_id = np.cumsum(np.r_[0, (np.diff(sorted_d) != 0).astype(np.int64)])
+        rank = np.arange(len(sorted_d)) - first_idx[group_id]
+        keep[candidates[by_dst[rank < limit]]] = True
+    return keep
+
+
+def cap_fan_in_jax(edge_mask, dst, limit: int, key) -> jnp.ndarray:
+    """Jit-friendly fan-in cap: every active edge draws a uniform priority,
+    edges are sorted (destination, priority) and the first ``limit`` active
+    edges per destination survive.  Returns a float mask of the same shape
+    as ``edge_mask``; inactive edges never survive."""
+    n_edges = edge_mask.shape[0]
+    active = edge_mask > 0
+    priority = jax.random.uniform(key, (n_edges,))
+    # inactive edges sort last within their destination segment
+    priority = jnp.where(active, priority, 2.0)
+    order = jnp.lexsort((priority, dst))
+    sorted_dst = dst[order]
+    # rank within each destination segment (sorted_dst is sorted, so the
+    # first occurrence index comes from searchsorted against itself)
+    first = jnp.searchsorted(sorted_dst, sorted_dst, side="left")
+    rank = jnp.arange(n_edges) - first
+    keep_sorted = (rank < limit) & (priority[order] < 1.5)
+    keep = jnp.zeros(n_edges, edge_mask.dtype).at[order].set(
+        keep_sorted.astype(edge_mask.dtype)
+    )
+    return keep
+
+
+def minibatch_assignment(train_mask, batch_number: int, key) -> jnp.ndarray:
+    """Jit-friendly balanced minibatch partition: rank the training nodes in
+    a random order and deal them round-robin into ``batch_number`` batches
+    (the reference's graph dataloader splits training nodes into
+    ``batch_number`` near-equal shuffled batches per epoch,
+    ``simulation_lib/worker/graph_worker.py:94-97``).  Returns an int32
+    batch id per node; non-training nodes get id ``batch_number`` (never
+    selected)."""
+    n = train_mask.shape[0]
+    r = jax.random.uniform(key, (n,))
+    r = jnp.where(train_mask > 0, r, jnp.inf)
+    order = jnp.argsort(r)  # training nodes first, random order
+    pos = jnp.zeros(n, jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+    return jnp.where(train_mask > 0, pos % batch_number, batch_number).astype(
+        jnp.int32
+    )
+
+
+__all__ = ["cap_fan_in", "cap_fan_in_jax", "minibatch_assignment"]

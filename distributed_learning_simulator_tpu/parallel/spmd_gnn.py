@@ -39,6 +39,10 @@ from .spmd import shard_map_compat
 
 
 class SpmdFedGNNSession:
+    # fed_aas resamples num_neighbor per ROUND host-side; the stock session
+    # applies it per minibatch inside the round program
+    _dataloader_num_neighbor = True
+
     def __init__(
         self,
         config,
@@ -134,7 +138,8 @@ class SpmdFedGNNSession:
         self._dataset_sizes = sizes
         hidden = int(getattr(self.model_ctx.module, "hidden", 64))
         boundaries = int(getattr(self.model_ctx.module, "num_mp_layers", 2)) - 1
-        steps = config.epoch  # full-batch: one exchange set per epoch
+        # one exchange set per minibatch per epoch (full-batch: 1/epoch)
+        steps = config.epoch * int(config.algorithm_kwargs.get("batch_number") or 1)
         self._round_payload_bytes = int(
             steps * boundaries * 4 * hidden * (provide_mask.sum() + recv_mask.sum())
         )
@@ -168,8 +173,16 @@ class SpmdFedGNNSession:
         epochs = self.config.epoch
         share_feature = self._share_feature
         num_layers = int(getattr(model, "num_mp_layers", 2))
+        batch_number = int(self.config.algorithm_kwargs.get("batch_number") or 1)
+        num_neighbor = (
+            self.config.algorithm_kwargs.get("num_neighbor")
+            if self._dataloader_num_neighbor
+            else None
+        )
+        minibatched = batch_number > 1 or num_neighbor is not None
 
         from ..models.graph import apply_mp_stage
+        from ..ops.graph_sampling import cap_fan_in_jax, minibatch_assignment
 
         def apply_stage(params, i, h, inputs, train, rng=None):
             variables = {"params": unflatten_nested(params)}
@@ -194,8 +207,12 @@ class SpmdFedGNNSession:
                         "edge_mask": edge_mask,
                     }
 
-                def epoch_body(carry, epoch_rngs):
-                    params_s, opt_s = carry
+                def train_one_batch(
+                    params_s, opt_s, local_m, cross_m, train_m, step_rngs
+                ):
+                    """One synchronized step across all slots: boundary
+                    exchange (psum per MP-layer boundary) + a local SGD step
+                    on ``train_m``-masked nodes."""
                     if share_feature:
                         # the reference's through-server barrier before each
                         # MessagePassing layer after the first, one psum per
@@ -206,7 +223,7 @@ class SpmdFedGNNSession:
                             lambda p, lm: apply_stage(
                                 p, 0, None, inputs_for(lm), False
                             )
-                        )(params_s, data["local_edges"])
+                        )(params_s, local_m)
                         for i in range(1, num_layers):
                             provide_sum = jnp.einsum(
                                 "sn,snh->nh", data["provide"], h_pay
@@ -224,7 +241,7 @@ class SpmdFedGNNSession:
                                     lambda p, h, cm, i=i: apply_stage(
                                         p, i, h, inputs_for(cm), False
                                     )
-                                )(params_s, h_mixed, data["cross_edges"])
+                                )(params_s, h_mixed, cross_m)
                     else:
                         tables = None
 
@@ -252,15 +269,78 @@ class SpmdFedGNNSession:
                         }
                         return p, o, metrics
 
-                    params_s, opt_s, metrics = jax.vmap(slot_step)(
+                    return jax.vmap(slot_step)(
                         params_s,
                         opt_s,
-                        data["local_edges"],
-                        data["cross_edges"],
+                        local_m,
+                        cross_m,
                         data["recv"],
-                        data["train_mask"],
-                        epoch_rngs,
+                        train_m,
+                        step_rngs,
                     )
+
+                def epoch_body(carry, epoch_rngs):
+                    params_s, opt_s = carry
+                    if not minibatched:
+                        params_s, opt_s, metrics = train_one_batch(
+                            params_s,
+                            opt_s,
+                            data["local_edges"],
+                            data["cross_edges"],
+                            data["train_mask"],
+                            epoch_rngs,
+                        )
+                        return (params_s, opt_s), metrics
+
+                    # reference graph dataloader semantics
+                    # (graph_worker.py:94-101): per-epoch shuffled node
+                    # minibatches, optional per-batch fan-in sampling; the
+                    # boundary exchange fires per BATCH per layer boundary
+                    assign = jax.vmap(
+                        lambda k, tm: minibatch_assignment(
+                            tm, batch_number, jax.random.fold_in(k, 7)
+                        )
+                    )(epoch_rngs, data["train_mask"])  # [S, n]
+                    dst = edge_index[1]
+
+                    def batch_body(carry, b):
+                        params_s, opt_s = carry
+                        train_b = data["train_mask"] * (assign == b)
+                        local_m, cross_m = (
+                            data["local_edges"],
+                            data["cross_edges"],
+                        )
+                        if num_neighbor is not None:
+                            keys = jax.vmap(
+                                lambda k: jax.random.fold_in(
+                                    jax.random.fold_in(k, 11), b
+                                )
+                            )(epoch_rngs)
+                            keep = jax.vmap(
+                                lambda m, k: cap_fan_in_jax(
+                                    m, dst, int(num_neighbor), k
+                                )
+                            )(cross_m, keys)
+                            local_m = local_m * keep
+                            cross_m = keep
+                        # disjoint fold-in domain from the assignment key
+                        # (7) and the neighbor-cap keys (11)
+                        step_rngs = jax.vmap(
+                            lambda k: jax.random.fold_in(
+                                jax.random.fold_in(k, 13), b
+                            )
+                        )(epoch_rngs)
+                        params_s, opt_s, metrics = train_one_batch(
+                            params_s, opt_s, local_m, cross_m, train_b, step_rngs
+                        )
+                        return (params_s, opt_s), metrics
+
+                    (params_s, opt_s), metrics = jax.lax.scan(
+                        batch_body,
+                        (params_s, opt_s),
+                        jnp.arange(batch_number, dtype=jnp.int32),
+                    )
+                    metrics = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
                     return (params_s, opt_s), metrics
 
                 epoch_rngs = jax.vmap(
@@ -409,6 +489,8 @@ class SpmdFedAASSession(SpmdFedGNNSession):
     GraphSAGE-style fan-in cap resampled each round (threaded counterpart:
     ``method/fed_aas/FedAASWorker._before_round``)."""
 
+    _dataloader_num_neighbor = False
+
     def __init__(self, *args, **kwargs) -> None:
         kwargs.setdefault("share_feature", False)
         super().__init__(*args, **kwargs)
@@ -422,7 +504,7 @@ class SpmdFedAASSession(SpmdFedGNNSession):
     def _before_round(self, round_number: int) -> None:
         if self._num_neighbor is None:
             return
-        from ..method.fed_aas import cap_fan_in
+        from ..ops.graph_sampling import cap_fan_in
 
         limit = int(self._num_neighbor)
         resampled = np.zeros_like(self._base_local, np.float32)

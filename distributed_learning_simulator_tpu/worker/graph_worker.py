@@ -49,6 +49,10 @@ class GraphWorker(AggregationWorker):
         self._cross_edge_mask: np.ndarray | None = None  # + cross training edges
         self.communicated_bytes = 0
         self.skipped_bytes = 0
+        self.exchange_count = 0
+        # fed_aas handles num_neighbor itself (per-round resampling); the
+        # stock GraphWorker forwards it to the dataloader like the reference
+        self._dataloader_num_neighbor = True
 
     # ------------------------------------------------------------- setup
     def _before_training(self) -> None:
@@ -61,6 +65,20 @@ class GraphWorker(AggregationWorker):
                 return
         self._exchange_training_node_indices()
         self._prune_edges()
+        # reference graph_worker.py:94-101: batch_number / num_neighbor are
+        # dataloader kwargs — each epoch trains `batch_number` shuffled
+        # training-node minibatches with optional fan-in sampling
+        if "batch_number" in self.config.algorithm_kwargs:
+            self.trainer.update_dataloader_kwargs(
+                batch_number=int(self.config.algorithm_kwargs["batch_number"])
+            )
+        if (
+            self._dataloader_num_neighbor
+            and "num_neighbor" in self.config.algorithm_kwargs
+        ):
+            self.trainer.update_dataloader_kwargs(
+                num_neighbor=int(self.config.algorithm_kwargs["num_neighbor"])
+            )
         if self._share_feature:
             self.trainer.append_named_hook(
                 ExecutorHookPoint.OPTIMIZER_STEP,
@@ -144,6 +162,7 @@ class GraphWorker(AggregationWorker):
             "boundary": self._boundary,
         }
         message = Message(in_round=True, other_data=payload)
+        self.exchange_count += 1
         self.communicated_bytes += param_nbytes(payload)
         self.send_data_to_server(message)
         result = self._get_data_from_server()
@@ -172,10 +191,19 @@ class GraphWorker(AggregationWorker):
         model = trainer.model_ctx.module
         num_layers = int(getattr(model, "num_mp_layers", 2))
         variables = {"params": unflatten_nested(params)}
+        # per-minibatch edge mask (fan-in sampled when num_neighbor is set);
+        # local ⊆ cross, so intersecting with the batch mask caps both
+        batch_edge = batch["input"].get("edge_mask")
+        local_mask = jnp.asarray(self._local_edge_mask)
+        cross_mask = jnp.asarray(self._cross_edge_mask)
+        if batch_edge is not None:
+            batch_edge = jnp.asarray(batch_edge)
+            local_mask = local_mask * batch_edge
+            cross_mask = cross_mask * batch_edge
         inputs_local = dict(batch["input"])
-        inputs_local["edge_mask"] = jnp.asarray(self._local_edge_mask)
+        inputs_local["edge_mask"] = local_mask
         inputs_cross = dict(batch["input"])
-        inputs_cross["edge_mask"] = jnp.asarray(self._cross_edge_mask)
+        inputs_cross["edge_mask"] = cross_mask
 
         from ..models.graph import apply_mp_stage
 
@@ -221,6 +249,7 @@ class GraphWorker(AggregationWorker):
         stat = {
             "communicated_bytes": int(self.communicated_bytes),
             "skipped_bytes": int(self.skipped_bytes),
+            "exchange_count": int(self.exchange_count),
             "boundary_size": int(len(self._boundary)),
             "edge_count": int(
                 self._cross_edge_mask.sum() if self._cross_edge_mask is not None else 0
