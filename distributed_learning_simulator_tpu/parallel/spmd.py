@@ -143,6 +143,12 @@ class SpmdFedAvgSession:
         self.model_ctx = model_ctx
         self.engine = engine
         self.mesh = mesh if mesh is not None else make_mesh()
+        from .watchdog import DeadlineWatchdog
+
+        # config.watchdog_seconds guards the SPMD path too (VERDICT r2
+        # item 4): a wedged round program / eval fetch aborts with a
+        # diagnostic instead of hanging the controller
+        self._watchdog = DeadlineWatchdog.from_config(config, self.mesh)
         # FSDP over the inner ``model`` axis (SURVEY.md §7 item 10: "inner
         # mesh axis for TP/FSDP of larger client models"): client slots
         # partition over BOTH axes (every device trains clients), global
@@ -512,8 +518,12 @@ class SpmdFedAvgSession:
                 # old global_params are donated into the round program —
                 # any pending background fetch of them must finish first
                 self._ckpt.barrier()
-                global_params, train_metrics = self._round_fn(
-                    global_params, weights, client_rngs
+                global_params, train_metrics = self._watchdog.call(
+                    lambda gp=global_params, w=weights, r=client_rngs: self._round_fn(
+                        gp, w, r
+                    ),
+                    phase="round",
+                    round_number=round_number,
                 )
                 # queue the round checkpoint NOW so its device→host fetch
                 # and disk write overlap the test-set evaluation below
@@ -522,7 +532,11 @@ class SpmdFedAvgSession:
                     self._checkpointable(global_params),
                 )
                 self._ckpt_queued_round = round_number
-                metric = self._evaluate(global_params)
+                metric = self._watchdog.call(
+                    lambda gp=global_params: self._evaluate(gp),
+                    phase="eval",
+                    round_number=round_number,
+                )
                 # same stat surface as the threaded server: analytic wire
                 # cost (what the aggregation consumed over ICI, priced at
                 # the reference's message sizes) + round wall time
@@ -643,6 +657,9 @@ class SpmdSignSGDSession:
         self.model_ctx = model_ctx
         self.engine = engine
         self.mesh = mesh if mesh is not None else make_mesh()
+        from .watchdog import DeadlineWatchdog
+
+        self._watchdog = DeadlineWatchdog.from_config(config, self.mesh)
         self.n_slots = client_slots(config.worker_number, self.mesh)
         self._stat: dict[int, dict] = {}
 
@@ -764,8 +781,16 @@ class SpmdSignSGDSession:
                 ),
                 self._client_sharding,
             )
-            params, epoch_metrics = self._run_fn(params, weights, rngs)
-            metric = summarize_metrics(self.engine.evaluate(params, batches))
+            params, epoch_metrics = self._watchdog.call(
+                lambda p=params, w=weights, r=rngs: self._run_fn(p, w, r),
+                phase="round",
+                round_number=round_number,
+            )
+            metric = self._watchdog.call(
+                lambda p=params: summarize_metrics(self.engine.evaluate(p, batches)),
+                phase="eval",
+                round_number=round_number,
+            )
             metric.update(
                 maybe_slow_metrics(self.config, self.engine, params, batches)
             )

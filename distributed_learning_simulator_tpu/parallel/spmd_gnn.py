@@ -58,6 +58,9 @@ class SpmdFedGNNSession:
         self.model_ctx = model_ctx
         self.engine = engine
         self.mesh = mesh if mesh is not None else make_mesh()
+        from .watchdog import DeadlineWatchdog
+
+        self._watchdog = DeadlineWatchdog.from_config(config, self.mesh)
         self.n_slots = client_slots(config.worker_number, self.mesh)
         self._share_feature = (
             config.algorithm_kwargs.get("share_feature", True)
@@ -426,16 +429,24 @@ class SpmdFedGNNSession:
                 # old global_params are donated into the round program —
                 # any pending background fetch of them must finish first
                 self._ckpt.barrier()
-                global_params, train_metrics = self._round_fn(
-                    global_params, weights, client_rngs
+                global_params, train_metrics = self._watchdog.call(
+                    lambda gp=global_params, w=weights, r=client_rngs: self._round_fn(
+                        gp, w, r
+                    ),
+                    phase="round",
+                    round_number=round_number,
                 )
                 # queued now so the fetch/write overlaps the evaluation
                 self._ckpt.save_npz(
                     os.path.join(model_dir, f"round_{round_number}.npz"),
                     global_params,
                 )
-                metric = summarize_metrics(
-                    self.engine.evaluate_single(global_params, test_batch)
+                metric = self._watchdog.call(
+                    lambda gp=global_params: summarize_metrics(
+                        self.engine.evaluate_single(gp, test_batch)
+                    ),
+                    phase="eval",
+                    round_number=round_number,
                 )
                 metric.update(
                     maybe_slow_metrics(

@@ -295,14 +295,20 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         )
         rng = jax.random.PRNGKey(config.seed)
 
-        def step(fn, params, weights):
+        def step(fn, params, weights, round_number, phase_label):
             nonlocal rng
             rng, round_rng, bcast_rng = jax.random.split(rng, 3)
             client_rngs = put_sharded(
                 jax.random.split(round_rng, self.n_slots), self._client_sharding
             )
             weights = put_sharded(weights, self._client_sharding)
-            exact, bcast, metrics = fn(params, weights, client_rngs, bcast_rng)
+            # distinct phase labels: phase 2 compiles its own program
+            # mid-run and must get its own compile grace
+            exact, bcast, metrics = self._watchdog.call(
+                lambda: fn(params, weights, client_rngs, bcast_rng),
+                phase=phase_label,
+                round_number=round_number,
+            )
             return exact, bcast, {
                 k: float(np.asarray(v)) for k, v in metrics.items()
             }
@@ -322,8 +328,18 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                     fn = self._phase2_fn
                     weights = self._all_weights()
                     stat_key = max(self._stat) + 1 if self._stat else 1
-                exact, train_params, met = step(fn, train_params, weights)
-                metric = self._evaluate(exact)  # phase 2: check_acc semantics
+                exact, train_params, met = step(
+                    fn,
+                    train_params,
+                    weights,
+                    stat_key,
+                    "round" if spec.block_dropout else "round-phase2",
+                )
+                metric = self._watchdog.call(
+                    lambda: self._evaluate(exact),
+                    phase="eval",
+                    round_number=stat_key,
+                )  # phase 2: check_acc semantics
                 self._record_obd(stat_key, metric, met, exact, save_dir)
                 improved = True
                 if driver.early_stop:

@@ -182,7 +182,11 @@ class SpmdShapleySession(SpmdFedAvgSession):
             client_rngs = put_sharded(
                 jax.random.split(round_rng, self.n_slots), self._client_sharding
             )
-            params_s, _ = self._round_fn(global_params, weights, client_rngs)
+            params_s, _ = self._watchdog.call(
+                lambda: self._round_fn(global_params, weights, client_rngs),
+                phase="round",
+                round_number=round_number,
+            )
 
             workers, metric_many = self._batch_metric(params_s, weights)
             if self._sv_engine is None:
@@ -191,10 +195,18 @@ class SpmdShapleySession(SpmdFedAvgSession):
                     last_round_metric=self._stat[0]["test_accuracy"],
                     **self._engine_kwargs(),
                 )
+            # each subset-batch evaluation gets its own deadline — the SV
+            # metric callbacks are the round's dominant device work and must
+            # not hang unguarded
+            def guarded_many(subsets, rn=round_number, fn=metric_many):
+                return self._watchdog.call(
+                    lambda: fn(subsets), phase="eval", round_number=rn
+                )
+
             self._sv_engine.set_metric_function(
-                lambda subset: metric_many([subset])[0]
+                lambda subset: guarded_many([subset])[0]
             )
-            self._sv_engine.set_batch_metric_function(metric_many)
+            self._sv_engine.set_batch_metric_function(guarded_many)
             self._sv_engine.compute(round_number=round_number)
             self.shapley_values[round_number] = dict(
                 self._sv_engine.shapley_values[round_number]

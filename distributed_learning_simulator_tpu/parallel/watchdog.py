@@ -1,0 +1,81 @@
+"""Deadline watchdog for the SPMD executor (VERDICT r2 item 4).
+
+The threaded executor already has a *message-progress* watchdog
+(``training._watchdog_loop``); the SPMD sessions had none — a wedged
+collective (multi-host especially) blocked ``run()`` forever with no
+diagnostic.  ``config.watchdog_seconds`` now also guards the default
+executor: every blocking device call in a session's round loop (the round
+program and the evaluation fetch) runs under a deadline; exceeding it
+raises ``TimeoutError`` with round number, phase, and mesh shape instead of
+hanging (SURVEY.md §5 TPU plan: "a 'deadline' watchdog on collective
+waits").
+
+The guarded call runs on a daemon thread — a blocked XLA execution cannot
+be interrupted from Python, so on timeout the call is *abandoned* (the
+process is aborting anyway) and the controller raises.
+
+The FIRST guarded call per phase gets ``compile_grace`` × the deadline:
+round-program compilation legitimately takes minutes on first invocation
+and must not trip a deadline sized for steady-state rounds.
+"""
+
+import threading
+
+from ..utils.logging import get_logger
+
+
+class DeadlineWatchdog:
+    def __init__(self, seconds: float, mesh=None, compile_grace: float = 10.0):
+        self.seconds = float(seconds or 0.0)
+        self.mesh = mesh
+        self.compile_grace = compile_grace
+        self._seen_phases: set[str] = set()
+
+    @classmethod
+    def from_config(cls, config, mesh=None) -> "DeadlineWatchdog":
+        return cls(getattr(config, "watchdog_seconds", 0.0) or 0.0, mesh=mesh)
+
+    def call(self, fn, *, phase: str, round_number: int):
+        """Run ``fn()`` under the deadline; raise TimeoutError on stall.
+
+        The guarded call is forced synchronous (``jax.block_until_ready`` on
+        its result) — jitted calls dispatch asynchronously, so without the
+        block a wedged round would "return" instantly and hang later at an
+        unguarded fetch.  ``phase`` keys the compile grace: distinct
+        programs (e.g. FedOBD phase 1 vs phase 2) must use distinct phase
+        labels so each first compile gets the grace."""
+        if self.seconds <= 0:
+            return fn()
+        deadline = self.seconds
+        if phase not in self._seen_phases:
+            self._seen_phases.add(phase)
+            deadline *= self.compile_grace  # first call compiles
+        result: dict = {}
+
+        def target() -> None:
+            try:
+                import jax
+
+                result["value"] = jax.block_until_ready(fn())
+            except BaseException as exc:  # surfaced on the caller thread
+                result["error"] = exc
+
+        thread = threading.Thread(
+            target=target, daemon=True, name=f"spmd-{phase}-r{round_number}"
+        )
+        thread.start()
+        thread.join(deadline)
+        if thread.is_alive():
+            mesh_shape = dict(self.mesh.shape) if self.mesh is not None else "?"
+            diag = (
+                f"watchdog: SPMD {phase!r} stalled > {deadline:.0f}s "
+                f"at round {round_number} (mesh {mesh_shape}); aborting"
+            )
+            get_logger().error(diag)
+            raise TimeoutError(diag)
+        if "error" in result:
+            raise result["error"]
+        return result["value"]
+
+
+__all__ = ["DeadlineWatchdog"]

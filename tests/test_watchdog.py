@@ -120,3 +120,59 @@ def test_stalled_training_raises(tmp_session_dir):
             train(config)
     finally:
         AggregationWorker.send_data_to_server = original
+
+
+def test_spmd_watchdog_unit():
+    """DeadlineWatchdog: deadline trips with a mesh/round/phase diagnostic;
+    first call per phase gets the compile grace."""
+    from distributed_learning_simulator_tpu.parallel.mesh import make_mesh
+    from distributed_learning_simulator_tpu.parallel.watchdog import (
+        DeadlineWatchdog,
+    )
+
+    wd = DeadlineWatchdog(0.1, mesh=make_mesh(), compile_grace=2.0)
+    # first call: 0.2s grace deadline, completes fine
+    assert wd.call(lambda: 42, phase="round", round_number=1) == 42
+    stop = threading.Event()
+    with pytest.raises(TimeoutError, match=r"SPMD 'round'.*round 3.*mesh"):
+        wd.call(lambda: stop.wait(30), phase="round", round_number=3)
+    stop.set()
+    # errors inside the guarded call surface on the caller
+    with pytest.raises(ValueError, match="boom"):
+        wd.call(lambda: (_ for _ in ()).throw(ValueError("boom")), phase="eval",
+                round_number=1)
+
+
+def test_spmd_watchdog_wedged_round_aborts(tmp_session_dir):
+    """End-to-end on the DEFAULT executor: a wedged round program (hung
+    collective stand-in) aborts with a diagnostic instead of hanging."""
+    from distributed_learning_simulator_tpu.parallel.spmd import SpmdFedAvgSession
+
+    original = SpmdFedAvgSession._build_round_fn
+
+    def wedged_build(self):
+        def wedge(global_params, weights, rngs):
+            threading.Event().wait(60)  # never completes within the test
+            raise AssertionError("unreachable")
+
+        return wedge
+
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        distributed_algorithm="fed_avg",
+        executor="spmd",
+        worker_number=2,
+        batch_size=16,
+        round=1,
+        epoch=1,
+        watchdog_seconds=0.2,
+        dataset_kwargs={"train_size": 64, "val_size": 16, "test_size": 32},
+        save_dir=str(tmp_session_dir / "spmd_stall"),
+    )
+    SpmdFedAvgSession._build_round_fn = wedged_build
+    try:
+        with pytest.raises(TimeoutError, match="SPMD 'round'"):
+            train(config)
+    finally:
+        SpmdFedAvgSession._build_round_fn = original
