@@ -153,6 +153,10 @@ def _text_collection(name: str, blob, max_len: int | None) -> DatasetCollection:
     }
     if "vocab" in blob:
         metadata["vocab"] = [str(w) for w in blob["vocab"]]
+    if "tokenizer_type" in blob:
+        # which tokenizer produced the ids (e.g. "spacy" for a
+        # pre-tokenized export matching the reference's ids)
+        metadata["tokenizer_type"] = str(blob["tokenizer_type"])
     num_classes = int(max(y_train.max(), y_test.max())) + 1
     return DatasetCollection(
         name=name,

@@ -160,9 +160,17 @@ def _text_factory(name: str, num_classes: int, default_train: int):
         tokenizer: dict | None = None,
         **_: object,
     ) -> DatasetCollection:
+        from .tokenizer import resolve_tokenizer_type
+
         real = _try_load_real(name, max_len=max_len)
         if real is not None:
+            # validate/dispatch dataset_kwargs.tokenizer (reference
+            # conf/fed_avg/imdb.yaml:16-18) against the ingested export
+            real.metadata["tokenizer"] = resolve_tokenizer_type(
+                tokenizer, real.metadata
+            )
             return real
+        resolve_tokenizer_type(tokenizer, None)  # reject unknown types loudly
         val_size_ = val_size or max(256, train_size // 8)
         test_size_ = test_size or max(512, train_size // 4)
         return _synthetic_text(

@@ -12,7 +12,48 @@ import re
 
 import numpy as np
 
+from ..utils.logging import get_logger
+
 _WORD_RE = re.compile(r"[a-z0-9']+")
+
+#: tokenizer types the config surface accepts
+#: (``dataset_kwargs.tokenizer.type``; the reference's IMDB configs say
+#: ``spacy`` — ``conf/fed_avg/imdb.yaml:16-18``)
+KNOWN_TOKENIZER_TYPES = ("spacy", "regex", "word")
+
+
+def resolve_tokenizer_type(
+    tokenizer_kwargs: dict | None, metadata: dict | None = None
+) -> str | None:
+    """Validate and dispatch ``dataset_kwargs.tokenizer``.
+
+    ``spacy`` resolves to the ingested npz's PRE-TOKENIZED ids when the
+    dataset was exported with spacy token ids (``tools/ingest_data.py
+    --tokenized-json``, metadata ``tokenizer_type == "spacy"``) — real-IMDB
+    ids then match the reference's exactly.  Without such an export the
+    deterministic regex tokenizer stands in (zero egress: no spacy model
+    download) and says so loudly.  Unknown types are rejected rather than
+    silently dropped (same loud-failure standard as ``cache_transforms``).
+    """
+    if not tokenizer_kwargs:
+        return None
+    if isinstance(tokenizer_kwargs, str):  # shorthand: `tokenizer: spacy`
+        tokenizer_kwargs = {"type": tokenizer_kwargs}
+    requested = str(tokenizer_kwargs.get("type", "regex")).lower()
+    if requested not in KNOWN_TOKENIZER_TYPES:
+        raise ValueError(
+            f"dataset_kwargs.tokenizer.type must be one of "
+            f"{KNOWN_TOKENIZER_TYPES}, got {requested!r}"
+        )
+    ingested = (metadata or {}).get("tokenizer_type")
+    if requested == "spacy" and ingested != "spacy":
+        get_logger().warning(
+            "tokenizer.type=spacy requested but the dataset carries no "
+            "spacy-tokenized export (ingest with --tokenized-json to match "
+            "reference ids); using the deterministic regex tokenizer"
+        )
+        return "regex"
+    return requested
 
 PAD_ID = 0
 UNK_ID = 1

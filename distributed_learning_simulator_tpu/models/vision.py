@@ -105,21 +105,53 @@ class ResNetBlock(nn.Module):
         return nn.relu(y + residual)
 
 
+class BottleneckBlock(nn.Module):
+    """1x1 reduce → 3x3 → 1x1 expand (×4) bottleneck — the torchvision
+    ResNet-50 block the reference zoo provides (import at
+    ``simulation_lib/method/common_import.py:1-2``)."""
+
+    features: int  # bottleneck width; the block outputs features * 4
+    strides: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        out_features = self.features * 4
+        residual = x
+        y = nn.Conv(self.features, (1, 1), use_bias=False)(x)
+        y = nn.GroupNorm(num_groups=_gn_groups(self.features))(y)
+        y = nn.relu(y)
+        y = nn.Conv(
+            self.features, (3, 3), self.strides, padding="SAME", use_bias=False
+        )(y)
+        y = nn.GroupNorm(num_groups=_gn_groups(self.features))(y)
+        y = nn.relu(y)
+        y = nn.Conv(out_features, (1, 1), use_bias=False)(y)
+        y = nn.GroupNorm(num_groups=_gn_groups(out_features))(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                out_features, (1, 1), self.strides, use_bias=False, name="shortcut"
+            )(x)
+            residual = nn.GroupNorm(num_groups=_gn_groups(out_features))(residual)
+        return nn.relu(y + residual)
+
+
 class ResNet(nn.Module):
     num_classes: int = 10
     stage_sizes: tuple[int, ...] = (2, 2, 2, 2)
     width: int = 64
+    bottleneck: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False)(x)
         x = nn.GroupNorm(num_groups=_gn_groups(self.width))(x)
         x = nn.relu(x)
+        block_cls = BottleneckBlock if self.bottleneck else ResNetBlock
         for stage, n_blocks in enumerate(self.stage_sizes):
             features = self.width * (2**stage)
             for block in range(n_blocks):
                 strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
-                x = ResNetBlock(features, strides)(x, train=train)
+                x = block_cls(features, strides)(x, train=train)
         x = x.mean(axis=(1, 2))
         return nn.Dense(self.num_classes)(x)
 
@@ -159,8 +191,14 @@ def _resnet18(dataset_collection, **kwargs) -> ModelContext:
 
 @register_model("resnet50", "ResNet50")
 def _resnet50(dataset_collection, **kwargs) -> ModelContext:
-    # bottleneck-free deep variant; stands in for the reference zoo's ResNet50
-    module = ResNet(num_classes=dataset_collection.num_classes, stage_sizes=(3, 4, 6, 3))
+    # true bottleneck ResNet-50 (3-4-6-3 of 1x1/3x3/1x1 blocks, ~25.6 M
+    # params at 1000 classes — the torchvision architecture the reference
+    # zoo imports, ``simulation_lib/method/common_import.py:1-2``)
+    module = ResNet(
+        num_classes=dataset_collection.num_classes,
+        stage_sizes=(3, 4, 6, 3),
+        bottleneck=True,
+    )
     return ModelContext(
         name="resnet50",
         module=module,

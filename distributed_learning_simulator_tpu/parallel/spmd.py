@@ -98,12 +98,27 @@ def shard_map_compat(fn, mesh, in_specs, out_specs):
 from ..ops.quantization import qsgd_quantize_dequantize as qsgd_dequantized
 
 
-def scan_local_epochs(engine, epochs: int, global_params, data, rng):
+def scan_local_epochs(
+    engine, epochs: int, global_params, data, rng, opt_state=None
+):
     """One client's local training: ``epochs`` of minibatch SGD from the
     fresh global params, optimizer rebuilt (AggregationWorker semantics,
-    ``util/model.py:6-23``).  Returns (params, summed metrics).  Shared by
-    every SPMD session's local-train body."""
-    opt_state = engine.optimizer.init(global_params)
+    ``util/model.py:6-23``) unless ``opt_state`` is given
+    (``reuse_learning_rate`` continuation — FedOBD phase 2).  Returns
+    (params, summed metrics).  Shared by every SPMD session's local-train
+    body; use :func:`scan_local_epochs_carry` to also get the final
+    optimizer state back."""
+    params, _, metrics = scan_local_epochs_carry(
+        engine, epochs, global_params, data, rng, opt_state
+    )
+    return params, metrics
+
+
+def scan_local_epochs_carry(
+    engine, epochs: int, global_params, data, rng, opt_state=None
+):
+    if opt_state is None:
+        opt_state = engine.optimizer.init(global_params)
 
     def epoch_body(carry, epoch_rng):
         params, opt_state = carry
@@ -112,10 +127,10 @@ def scan_local_epochs(engine, epochs: int, global_params, data, rng):
         )
         return (params, opt_state), metrics
 
-    (params, _), metrics = jax.lax.scan(
+    (params, opt_state), metrics = jax.lax.scan(
         epoch_body, (global_params, opt_state), jax.random.split(rng, epochs)
     )
-    return params, jax.tree.map(lambda x: jnp.sum(x), metrics)
+    return params, opt_state, jax.tree.map(lambda x: jnp.sum(x), metrics)
 
 
 class SpmdFedAvgSession:

@@ -24,11 +24,14 @@ In-program equivalents of the host-side machinery:
 Host side keeps the reference's phase state machine (rounds → phase 2 on
 exhaustion/plateau → end), round records, and best-model artifact.
 
-Deviation (documented): phase 2 restarts client optimizer state instead of
-carrying it across the phase switch; the periodic cosine schedule then
-matches torch's ``CosineAnnealingLR`` continuation the reference relies on.
-The threaded executor (``method/fed_obd``) remains the step-for-step parity
-implementation.
+Optimizer continuation (``reuse_learning_rate``, reference
+``util/model.py:6-23``): phase 1 rebuilds each client's optimizer per round
+(AggregationWorker semantics) but RETURNS the final per-slot optimizer
+states; at the phase switch those states seed phase 2, and every phase-2
+epoch threads them through — the schedule position and momentum continue
+across the switch and across phase-2 epochs exactly as on the threaded
+executor (``method/fed_obd/worker.py`` + ``Trainer.load_parameter_dict``
+with ``reuse_learning_rate=True``).
 """
 
 import os
@@ -41,7 +44,7 @@ from ..method.fed_obd.obd_algorithm import get_module_blocks
 from ..ops.quantization import nnadq_quantize_dequantize
 from ..utils.logging import get_logger
 from .mesh import put_sharded
-from .spmd import SpmdFedAvgSession, scan_local_epochs, shard_map_compat
+from .spmd import SpmdFedAvgSession, scan_local_epochs_carry, shard_map_compat
 from jax.sharding import PartitionSpec as P
 
 
@@ -128,10 +131,12 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             _, keep_ord = jax.lax.scan(body, jnp.float32(0.0), sizes_ord)
             return jnp.zeros(block_sizes.shape[0], bool).at[order].set(keep_ord)
 
-        def local_train(global_params, data, weight, rng):
+        def local_train(global_params, data, weight, rng, opt_state=None):
             rng, quant_rng = jax.random.split(rng)
-            params, summed = scan_local_epochs(
-                engine, epochs, global_params, data, rng
+            # phase 1: optimizer rebuilt per round (opt_state None); phase 2:
+            # reuse_learning_rate continuation from the carried state
+            params, opt_out, summed = scan_local_epochs_carry(
+                engine, epochs, global_params, data, rng, opt_state
             )
 
             selected = (weight > 0).astype(jnp.float32)
@@ -166,7 +171,7 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                     upload_bits += mask * bits * v.size
             contribution = jax.tree.map(lambda p: p * weight, upload)
             summed = dict(summed, upload_bits=upload_bits * selected)
-            return contribution, summed
+            return contribution, opt_out, summed
 
         def chunk_size(slots_local: int) -> int:
             mb = self.client_chunk
@@ -177,14 +182,24 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 mb -= 1
             return mb
 
-        def round_program(global_params, weights, rngs, bcast_rng, data):
-            def shard_body(global_params, data, weights, rngs, bcast_rng):
+        def round_program(global_params, opt_state_s, weights, rngs, bcast_rng, data):
+            def shard_body(global_params, opt_state_s, data, weights, rngs, bcast_rng):
                 slots_local = weights.shape[0]
                 mb = chunk_size(slots_local)
+
+                def run_slots(d, w, r, o):
+                    if phase_two:
+                        return jax.vmap(
+                            local_train, in_axes=(None, 0, 0, 0, 0)
+                        )(global_params, d, w, r, o)
+                    return jax.vmap(local_train, in_axes=(None, 0, 0, 0))(
+                        global_params, d, w, r
+                    )
+
                 if mb == slots_local:
-                    contributions, metrics = jax.vmap(
-                        local_train, in_axes=(None, 0, 0, 0)
-                    )(global_params, data, weights, rngs)
+                    contributions, opt_out, metrics = run_slots(
+                        data, weights, rngs, opt_state_s
+                    )
                     local_sum = jax.tree.map(
                         lambda c: jnp.sum(c, axis=0), contributions
                     )
@@ -199,19 +214,19 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                             lambda x: x.reshape(n_chunks, mb, *x.shape[1:]), tree
                         )
 
-                    chunks = (to_chunks(data), to_chunks(weights), to_chunks(rngs))
-                    _, met_shapes = jax.eval_shape(
-                        lambda d, w, r: jax.vmap(
-                            local_train, in_axes=(None, 0, 0, 0)
-                        )(global_params, d, w, r),
-                        *jax.tree.map(lambda x: x[0], chunks),
+                    chunks = (
+                        to_chunks(data),
+                        to_chunks(weights),
+                        to_chunks(rngs),
+                        to_chunks(opt_state_s) if phase_two else None,
+                    )
+                    _, _, met_shapes = jax.eval_shape(
+                        run_slots, *jax.tree.map(lambda x: x[0], chunks)
                     )
 
                     def chunk_body(acc, chunk):
-                        data_k, w_k, r_k = chunk
-                        contrib, met = jax.vmap(
-                            local_train, in_axes=(None, 0, 0, 0)
-                        )(global_params, data_k, w_k, r_k)
+                        data_k, w_k, r_k, o_k = chunk
+                        contrib, opt_k, met = run_slots(data_k, w_k, r_k, o_k)
                         acc_sum, acc_met = acc
                         acc_sum = jax.tree.map(
                             lambda a, c: a + jnp.sum(c, axis=0), acc_sum, contrib
@@ -219,7 +234,8 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                         acc_met = jax.tree.map(
                             lambda a, m: a + jnp.sum(m), acc_met, met
                         )
-                        return (acc_sum, acc_met), None
+                        # per-slot optimizer states collect as scan outputs
+                        return (acc_sum, acc_met), opt_k
 
                     init = (
                         jax.tree.map(
@@ -228,8 +244,12 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                         ),
                         jax.tree.map(lambda s: jnp.zeros((), s.dtype), met_shapes),
                     )
-                    (local_sum, metrics), _ = jax.lax.scan(
+                    (local_sum, metrics), opt_chunks = jax.lax.scan(
                         chunk_body, init, chunks
+                    )
+                    opt_out = jax.tree.map(
+                        lambda x: x.reshape(slots_local, *x.shape[2:]),
+                        opt_chunks,
                     )
                 global_sum = jax.tree.map(
                     lambda s: jax.lax.psum(s, axis_name="clients"), local_sum
@@ -257,20 +277,31 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                     bcast[k] = vq.astype(v.dtype)
                     bcast_bits += bits * v.size
                 metrics = dict(metrics, bcast_bits=bcast_bits)
-                return new_global, bcast, metrics
+                return new_global, bcast, opt_out, metrics
 
             return shard_map_compat(
                 shard_body,
                 self.mesh,
-                in_specs=(P(), P("clients"), P("clients"), P("clients"), P()),
-                out_specs=(P(), P(), P()),
-            )(global_params, data, weights, rngs, bcast_rng)
+                in_specs=(
+                    P(),
+                    P("clients"),
+                    P("clients"),
+                    P("clients"),
+                    P("clients"),
+                    P(),
+                ),
+                out_specs=(P(), P(), P("clients"), P()),
+            )(global_params, opt_state_s, data, weights, rngs, bcast_rng)
 
-        # data as an argument, not a closure constant (see spmd.py)
-        jitted = jax.jit(round_program, donate_argnums=(0,))
+        # data as an argument, not a closure constant (see spmd.py); phase 2
+        # also donates the carried optimizer states (same shape in and out)
+        donate = (0, 1) if phase_two else (0,)
+        jitted = jax.jit(round_program, donate_argnums=donate)
 
-        def fn(global_params, weights, rngs, bcast_rng):
-            return jitted(global_params, weights, rngs, bcast_rng, self._data)
+        def fn(global_params, weights, rngs, bcast_rng, opt_state_s=None):
+            return jitted(
+                global_params, opt_state_s, weights, rngs, bcast_rng, self._data
+            )
 
         return fn
 
@@ -295,8 +326,10 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         )
         rng = jax.random.PRNGKey(config.seed)
 
-        def step(fn, params, weights, round_number, phase_label):
-            nonlocal rng
+        opt_state_s = None  # per-slot optimizer states, carried round-to-round
+
+        def step(fn, params, weights, round_number, phase_label, use_opt):
+            nonlocal rng, opt_state_s
             rng, round_rng, bcast_rng = jax.random.split(rng, 3)
             client_rngs = put_sharded(
                 jax.random.split(round_rng, self.n_slots), self._client_sharding
@@ -304,11 +337,18 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             weights = put_sharded(weights, self._client_sharding)
             # distinct phase labels: phase 2 compiles its own program
             # mid-run and must get its own compile grace
-            exact, bcast, metrics = self._watchdog.call(
-                lambda: fn(params, weights, client_rngs, bcast_rng),
+            exact, bcast, opt_state_s, metrics = self._watchdog.call(
+                lambda: fn(
+                    params,
+                    weights,
+                    client_rngs,
+                    bcast_rng,
+                    opt_state_s if use_opt else None,
+                ),
                 phase=phase_label,
                 round_number=round_number,
             )
+            self._opt_state_s = opt_state_s  # observable continuation state
             return exact, bcast, {
                 k: float(np.asarray(v)) for k, v in metrics.items()
             }
@@ -325,6 +365,16 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 else:
                     if self._phase2_fn is None:
                         self._phase2_fn = self._build_phase_fn(phase_two=True)
+                    if opt_state_s is None:
+                        # phase 2 with no phase-1 rounds before it: fresh
+                        # per-slot optimizers (nothing to continue from)
+                        opt_state_s = jax.jit(
+                            jax.vmap(
+                                self.engine.optimizer.init,
+                                in_axes=None,
+                                axis_size=self.n_slots,
+                            )
+                        )(train_params)
                     fn = self._phase2_fn
                     weights = self._all_weights()
                     stat_key = max(self._stat) + 1 if self._stat else 1
@@ -334,6 +384,7 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                     weights,
                     stat_key,
                     "round" if spec.block_dropout else "round-phase2",
+                    use_opt=not spec.block_dropout,
                 )
                 metric = self._watchdog.call(
                     lambda: self._evaluate(exact),

@@ -9,7 +9,11 @@
   sparsified delta uploads.  The per-client residual is a device-resident
   state carried across rounds through the program — no host round-trips.
   ``topk_ratio`` selects magnitude thresholding (per-tensor k-th value via
-  ``lax.top_k``; ties can admit a few extra elements — the threaded path's
+  ``lax.top_k``; ties at the threshold admit extra elements — bounded by
+  the tie multiplicity m: both paths agree on every element strictly above/
+  below the threshold, the drift is < m kept elements, and for continuous
+  float32 deltas ties have measure zero so the kept sets are identical —
+  asserted in ``tests/test_smafd_topk_drift.py``.  The threaded path's
   native ``nth_element`` picker stays exact); otherwise random whole-tensor
   dropout under the ``1-dropout_rate`` parameter budget, matching
   ``RandomDropoutAlgorithm``.

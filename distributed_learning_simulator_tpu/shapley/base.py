@@ -58,6 +58,11 @@ class ShapleyValueEngine:
         # round -> {player: sv} restricted to the best-metric subset
         self.shapley_values_S: dict[int, dict] = {}
         self._cache: dict[frozenset, float] = {}
+        # subsets the SEQUENTIAL evaluation order actually visits — the
+        # batched prefetch fills ``_cache`` with prefixes a truncated walk
+        # never evaluates, and the best-subset pick must not see those
+        # (``choose_best_subset`` must behave identically on both paths)
+        self._considered: set[frozenset] = set()
 
     def set_metric_function(self, fn: Callable[[Iterable], float]) -> None:
         self.metric_fn = fn
@@ -90,15 +95,22 @@ class ShapleyValueEngine:
         key = frozenset(subset)
         if not key:
             return self.last_round_metric
+        self._considered.add(key)
         if key not in self._cache:
             assert self.metric_fn is not None
             self._cache[key] = float(self.metric_fn(tuple(sorted(key))))
         return self._cache[key]
 
     def _best_subset(self) -> frozenset:
-        if not self._cache:
+        candidates = self._considered or set(self._cache)
+        if not candidates:
             return frozenset()
-        return max(self._cache, key=self._cache.get)
+        # deterministic tie-break (value, then lexicographic members) so the
+        # pick cannot depend on cache-insertion order
+        return max(
+            candidates,
+            key=lambda k: (self._cache[k], tuple(sorted(k, reverse=True))),
+        )
 
     def compute(self, round_number: int) -> None:
         raise NotImplementedError
@@ -113,3 +125,4 @@ class ShapleyValueEngine:
         if full_metric is not None:
             self.last_round_metric = full_metric
         self._cache.clear()
+        self._considered.clear()

@@ -82,10 +82,12 @@ class GTGShapleyValue(ShapleyValueEngine):
             if getattr(self, "batch_metric_fn", None) is not None:
                 # one program evaluates the whole permutation's prefixes;
                 # the truncation rule below replays the sequential decisions
-                # from the cached values, so the SVs are identical (the
-                # best-subset pick may differ: it sees the extra evaluated
-                # prefixes).  Only when a batch evaluator exists — the
-                # sequential fallback would defeat truncation's point
+                # from the cached values, so the SVs are identical — and so
+                # is ``choose_best_subset``: only prefixes the sequential
+                # walk actually visits enter ``_considered``, never the
+                # extra prefetched ones.  Only when a batch evaluator
+                # exists — the sequential fallback would defeat
+                # truncation's point
                 self._metric_many(
                     {frozenset(perm[: i + 1]) for i in range(len(perm))}
                 )

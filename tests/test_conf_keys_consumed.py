@@ -1,0 +1,126 @@
+"""Config honesty audit (VERDICT r2 item 8): every key appearing anywhere
+in the ``conf/**`` YAML tree must be CONSUMED by a named module (the
+curated map below) — an accepted-but-never-read key is a silent config
+drop, the failure mode ``batch_number`` had before round 3.
+
+Two guarantees:
+
+* every top-level YAML key is a ``DistributedTrainingConfig`` field
+  (unknown keys already warn at load, ``config._merge_conf_dict``);
+* every NESTED kwarg key maps to a consumer module whose source actually
+  mentions it.  Adding a new key to any conf without wiring a consumer —
+  or without registering it here — fails this test.
+"""
+
+import dataclasses
+import glob
+import os
+
+import yaml
+
+from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "distributed_learning_simulator_tpu")
+
+# nested conf key -> module(s) that read it (any one listed must mention it)
+CONSUMERS: dict[tuple[str, str], list[str]] = {
+    ("algorithm_kwargs", "batch_number"): [
+        "worker/graph_worker.py",
+        "parallel/spmd_gnn.py",
+    ],
+    ("algorithm_kwargs", "dropout_rate"): [
+        "parallel/spmd_obd.py",
+        "method/fed_dropout_avg/__init__.py",
+    ],
+    ("algorithm_kwargs", "edge_drop_rate"): [
+        "worker/graph_worker.py",
+        "parallel/spmd_gnn.py",
+    ],
+    ("algorithm_kwargs", "num_neighbor"): [
+        "worker/graph_worker.py",
+        "parallel/spmd_gnn.py",
+    ],
+    ("algorithm_kwargs", "part_number"): ["method/shapley_value/servers.py"],
+    ("algorithm_kwargs", "vp_size"): ["method/shapley_value/servers.py"],
+    ("algorithm_kwargs", "random_client_number"): [
+        "server/server.py",
+        "utils/selection.py",
+    ],
+    ("algorithm_kwargs", "second_phase_epoch"): ["method/fed_obd/driver.py"],
+    ("algorithm_kwargs", "share_feature"): [
+        "worker/graph_worker.py",
+        "parallel/spmd_gnn.py",
+    ],
+    ("dataset_kwargs", "max_len"): ["data/registry.py"],
+    ("dataset_kwargs", "name"): ["data/registry.py"],
+    ("dataset_kwargs", "tokenizer"): ["data/tokenizer.py", "data/registry.py"],
+    ("dataset_kwargs.tokenizer", "type"): ["data/tokenizer.py"],
+    ("endpoint_kwargs", "server"): ["topology/quantized_endpoint.py"],
+    ("endpoint_kwargs", "worker"): ["topology/quantized_endpoint.py"],
+    ("endpoint_kwargs.server", "weight"): ["topology/quantized_endpoint.py"],
+    ("endpoint_kwargs.worker", "weight"): ["topology/quantized_endpoint.py"],
+    ("extra_hyper_parameters", "num_neighbor"): ["method/fed_aas/__init__.py"],
+    ("model_kwargs", "d_model"): ["models/text.py"],
+    ("model_kwargs", "nhead"): ["models/text.py"],
+    ("model_kwargs", "num_encoder_layer"): ["models/text.py"],
+    ("model_kwargs", "max_len"): ["models/text.py"],
+    ("model_kwargs", "word_vector_name"): ["models/text.py"],
+}
+
+DICT_FIELDS = {
+    f.name
+    for f in dataclasses.fields(DistributedTrainingConfig)
+    if f.default_factory is dict  # type: ignore[comparison-overlap]
+}
+FIELD_NAMES = {f.name for f in dataclasses.fields(DistributedTrainingConfig)}
+
+
+def _conf_tree():
+    for path in glob.glob(os.path.join(REPO, "conf", "**", "*.yaml"), recursive=True):
+        with open(path, encoding="utf8") as f:
+            conf = yaml.safe_load(f) or {}
+        while "dataset_name" not in conf and len(conf) == 1:
+            conf = next(iter(conf.values()))
+        yield path, conf
+
+
+def test_every_top_level_key_is_a_config_field():
+    for path, conf in _conf_tree():
+        for key in conf:
+            assert key in FIELD_NAMES, f"{path}: unknown top-level key {key!r}"
+
+
+def _walk_nested(field: str, value):
+    if not isinstance(value, dict):
+        return
+    for key, sub in value.items():
+        yield field, key
+        if isinstance(sub, dict):
+            yield from _walk_nested(f"{field}.{key}", sub)
+
+
+def test_every_nested_key_has_a_registered_consumer():
+    seen: set[tuple[str, str]] = set()
+    for path, conf in _conf_tree():
+        for field, value in conf.items():
+            if field in DICT_FIELDS:
+                for entry in _walk_nested(field, value):
+                    seen.add((path, *entry))
+    assert seen
+    for path, field, key in sorted(seen):
+        assert (field, key) in CONSUMERS, (
+            f"{path}: {field}.{key} has no registered consumer — wire it "
+            "and add it to CONSUMERS (silent config drops are forbidden)"
+        )
+
+
+def test_registered_consumers_actually_mention_their_key():
+    for (field, key), modules in CONSUMERS.items():
+        hit = False
+        for module in modules:
+            with open(os.path.join(PKG, module), encoding="utf8") as f:
+                if key in f.read():
+                    hit = True
+                    break
+        assert hit, f"none of {modules} mentions {field}.{key}"

@@ -122,3 +122,19 @@ def test_vit_tiny_fed_avg_round():
     result = train(config)
     assert 1 in result["performance"]
     assert "test_accuracy" in result["performance"][1]
+
+
+def test_resnet50_is_bottleneck_25_6M():
+    """'resnet50' is the real ~25.6 M-param bottleneck 3-4-6-3 architecture
+    (VERDICT r2 item 9), not a basic-block stand-in."""
+    import jax
+    import numpy as np
+
+    from distributed_learning_simulator_tpu.models.vision import ResNet
+
+    module = ResNet(num_classes=1000, stage_sizes=(3, 4, 6, 3), bottleneck=True)
+    params = module.init(
+        jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32)
+    )
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    assert 25.0e6 < n_params < 26.2e6, n_params

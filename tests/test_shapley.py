@@ -208,3 +208,37 @@ def test_hierarchical_batch_path_same_sv():
         return engine.shapley_values[1]
 
     assert make(batch=True) == make(batch=False)
+
+
+def test_gtg_batch_path_same_best_subset():
+    """``choose_best_subset`` pick is identical on both paths (VERDICT r2
+    item 7): a non-additive game where a TRUNCATED prefix holds the global
+    max — the batched prefetch evaluates it, the sequential walk never
+    does, and the pick must ignore it on both paths."""
+
+    def game(subset):
+        s = frozenset(subset)
+        if len(s) == 1:
+            return 0.4995  # within eps of full -> truncates from element 2 on
+        if len(s) == 2:
+            return 0.95  # global max, but never sequentially evaluated
+        return 0.5  # full coalition
+
+    def make(batch: bool):
+        engine = GTGShapleyValue(
+            players=[0, 1, 2], last_round_metric=0.0, eps=0.001, seed=3
+        )
+        engine.set_metric_function(game)
+        if batch:
+            engine.set_batch_metric_function(
+                lambda subsets: [game(s) for s in subsets]
+            )
+        engine.compute(round_number=1)
+        return engine
+
+    seq, bat = make(False), make(True)
+    assert bat.shapley_values[1] == seq.shapley_values[1]
+    # identical best-subset restriction — and it is the full coalition, not
+    # the prefetched-only 2-element max
+    assert bat.shapley_values_S[1] == seq.shapley_values_S[1]
+    assert sorted(seq.shapley_values_S[1]) == [0, 1, 2]

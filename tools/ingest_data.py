@@ -241,6 +241,50 @@ def ingest_imdb(
     )
 
 
+def ingest_imdb_tokenized(
+    src: str, out: str, max_len: int = 300, vocab_size: int = 20000
+) -> str:
+    """Pre-tokenized IMDB export: ``src`` is a JSON file
+
+    .. code-block:: json
+
+        {"tokenizer": "spacy",
+         "vocab": ["the", ...],                 // optional
+         "train": {"tokens": [["this", ...]], "labels": [1, ...]},
+         "test":  {"tokens": [...], "labels": [...]}}
+
+    produced by running the reference's tokenizer (spacy,
+    ``conf/fed_avg/imdb.yaml:16-18``) wherever spacy is available; the ids
+    written here then match the reference's exactly.  The vocab (given or
+    built from the train tokens) round-trips into the npz so the runtime
+    tokenizer reproduces the same table."""
+    import json
+
+    with open(src, encoding="utf8") as f:
+        blob = json.load(f)
+    train_docs = [list(doc) for doc in blob["train"]["tokens"]]
+    test_docs = [list(doc) for doc in blob["test"]["tokens"]]
+    vocab = (
+        [str(w) for w in blob["vocab"]]
+        if blob.get("vocab")
+        else build_vocab(train_docs, vocab_size)
+    )
+    return _write(
+        out,
+        "imdb",
+        kind="text",
+        x_train=encode(train_docs, vocab, max_len),
+        y_train=np.asarray(blob["train"]["labels"], np.int32),
+        x_test=encode(test_docs, vocab, max_len),
+        y_test=np.asarray(blob["test"]["labels"], np.int32),
+        vocab_size=np.int64(len(vocab) + _N_SPECIALS),
+        max_len=np.int64(max_len),
+        pad_id=np.int64(PAD_ID),
+        vocab=np.asarray(vocab),
+        tokenizer_type=np.str_(str(blob.get("tokenizer", "spacy"))),
+    )
+
+
 def ingest_planetoid(src: str, out: str, name: str = "cora") -> str:
     """The ind.<name>.{x,tx,allx,y,ty,ally,graph,test.index} pickle set
     (Kipf planetoid distribution; scipy sparse matrices inside)."""
@@ -363,7 +407,11 @@ def main(argv=None) -> int:
     for cmd in ("mnist", "fashionmnist", "cifar10", "cifar100", "imdb",
                 "planetoid", "graph-npz", "glove"):
         p = sub.add_parser(cmd)
-        p.add_argument("--src", required=True, help="source file/directory")
+        # imdb can take its input from --tokenized-json instead
+        p.add_argument(
+            "--src", required=(cmd != "imdb"), default="",
+            help="source file/directory",
+        )
         p.add_argument(
             "--out",
             default=os.environ.get("DLS_TPU_DATA_DIR", ""),
@@ -378,9 +426,16 @@ def main(argv=None) -> int:
         if cmd == "imdb":
             p.add_argument("--max-len", type=int, default=300)
             p.add_argument("--vocab-size", type=int, default=20000)
+            p.add_argument(
+                "--tokenized-json",
+                default="",
+                help="pre-tokenized export (spacy ids match the reference)",
+            )
     args = parser.parse_args(argv)
     if not args.out:
         parser.error("--out or $DLS_TPU_DATA_DIR required")
+    if args.cmd == "imdb" and not args.src and not args.tokenized_json:
+        parser.error("imdb requires --src or --tokenized-json")
     if args.cmd == "mnist":
         ingest_mnist(args.src, args.out, "MNIST")
     elif args.cmd == "fashionmnist":
@@ -390,7 +445,12 @@ def main(argv=None) -> int:
     elif args.cmd == "cifar100":
         ingest_cifar100(args.src, args.out)
     elif args.cmd == "imdb":
-        ingest_imdb(args.src, args.out, args.max_len, args.vocab_size)
+        if args.tokenized_json:
+            ingest_imdb_tokenized(
+                args.tokenized_json, args.out, args.max_len, args.vocab_size
+            )
+        else:
+            ingest_imdb(args.src, args.out, args.max_len, args.vocab_size)
     elif args.cmd == "planetoid":
         ingest_planetoid(args.src, args.out, args.name)
     elif args.cmd == "graph-npz":
