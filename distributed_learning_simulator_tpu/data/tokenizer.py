@@ -19,7 +19,7 @@ _WORD_RE = re.compile(r"[a-z0-9']+")
 #: tokenizer types the config surface accepts
 #: (``dataset_kwargs.tokenizer.type``; the reference's IMDB configs say
 #: ``spacy`` — ``conf/fed_avg/imdb.yaml:16-18``)
-KNOWN_TOKENIZER_TYPES = ("spacy", "regex", "word")
+KNOWN_TOKENIZER_TYPES = ("spacy", "regex")
 
 
 def resolve_tokenizer_type(

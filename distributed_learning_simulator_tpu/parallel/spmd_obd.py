@@ -188,13 +188,11 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 mb = chunk_size(slots_local)
 
                 def run_slots(d, w, r, o):
-                    if phase_two:
-                        return jax.vmap(
-                            local_train, in_axes=(None, 0, 0, 0, 0)
-                        )(global_params, d, w, r, o)
-                    return jax.vmap(local_train, in_axes=(None, 0, 0, 0))(
-                        global_params, d, w, r
-                    )
+                    # phase 1: o is None (optimizer rebuilt per round)
+                    return jax.vmap(
+                        local_train,
+                        in_axes=(None, 0, 0, 0, 0 if phase_two else None),
+                    )(global_params, d, w, r, o)
 
                 if mb == slots_local:
                     contributions, opt_out, metrics = run_slots(
