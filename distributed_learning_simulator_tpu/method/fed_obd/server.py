@@ -23,9 +23,77 @@ class FedOBDServer(AggregationServer):
         kwargs.setdefault("algorithm", FedAVGAlgorithm())
         super().__init__(**kwargs)
         self._driver = ObdRoundDriver.from_config(self.config)
+        self._last_phase_name = ""  # phase that produced the pending stat
         assert isinstance(self._endpoint, QuantServerEndpoint)
         # global-model broadcasts ride the same codec as uploads
         self._endpoint.quant_broadcast = True
+
+    def _annotate_stat(self, round_stat: dict) -> None:
+        if self._last_phase_name:
+            round_stat["phase"] = self._last_phase_name
+
+    def _try_resume(self):
+        """Base resume restores params/round/stats; the phase driver must
+        then be fast-forwarded by replaying its transition rules over the
+        restored aggregates (same replay as ``SpmdFedOBDSession``) — a
+        fresh driver would re-run the whole phase-1 budget."""
+        resumed = super()._try_resume()
+        if resumed is None:
+            return None
+        stats = self.performance_stat
+        phase1_kept = 0
+        dropped_from = None
+        replayed_accs: list[float] = []
+        for key in sorted(k for k in stats if k > 0):
+            spec = self._driver.phase
+            if spec is None:
+                break
+            recorded_phase = stats[key].get("phase", "")
+            if recorded_phase and recorded_phase != spec.name:
+                # record diverges from the new schedule (e.g. raised round
+                # budget): keep the consistent prefix, drop the whole tail
+                dropped_from = key
+                for stale in [k for k in stats if k >= key]:
+                    del stats[stale]
+                get_logger().info(
+                    "resume: dropping recorded aggregates from %d on (%s "
+                    "under the old schedule, %s under the new)",
+                    key,
+                    recorded_phase,
+                    spec.name,
+                )
+                break
+            if spec.block_dropout:
+                phase1_kept += 1
+            replayed_accs.append(stats[key].get("test_accuracy", 0.0))
+            # plateau over the GROWING prefix, not the fully-restored
+            # record (_convergent's watermark was already pre-set to the
+            # restored maximum and would call every replayed entry a
+            # plateau tick)
+            improved = True
+            if self._driver.early_stop and len(replayed_accs) >= 6:
+                improved = max(replayed_accs[-5:]) > max(replayed_accs[:-5])
+            self._driver.after_aggregate(
+                improved=improved, check_acc=spec.check_acc
+            )
+        # the base resume numbered the round after the LATEST checkpoint;
+        # the replayed schedule may have dropped that tail — round and
+        # params must follow the kept prefix (stat key == round_N.npz name)
+        self._round_number = phase1_kept + 1
+        if dropped_from is not None and stats:
+            from ...util.resume import load_round_checkpoint
+
+            kept = load_round_checkpoint(
+                self.config.algorithm_kwargs["resume_dir"], max(stats)
+            )
+            if kept is not None:
+                resumed = kept
+        get_logger().info(
+            "resume: fed_obd driver fast-forwarded to %s (round -> %d)",
+            self._driver.phase.name if self._driver.phase else "finished",
+            self._round_number,
+        )
+        return resumed
 
     def _select_workers(self) -> set[int]:
         phase = self._driver.phase
@@ -47,6 +115,9 @@ class FedOBDServer(AggregationServer):
     def _aggregate_worker_data(self) -> ParameterMessageBase:
         result = super()._aggregate_worker_data()
         assert result is not None
+        # capture the phase that PRODUCED this aggregate before the driver
+        # possibly switches (the stat is recorded after the decision)
+        self._last_phase_name = self._driver.phase.name if self._driver.phase else ""
         improved = True
         if self._driver.early_stop and self.performance_stat:
             improved = not self._convergent()
@@ -66,6 +137,16 @@ class FedOBDServer(AggregationServer):
             result.end_training = True
             self._driver.stop_now()
         return result
+
+    def _init_annotations(self) -> dict:
+        # a resume that fast-forwarded into phase 2 must tell the freshly
+        # started workers on the INIT message so they adopt the
+        # epoch-cadence spec (the phase-switch annotation they never saw)
+        from .driver import EPOCH_TUNE, PHASE_TWO_KEY
+
+        if self._driver.phase is EPOCH_TUNE:
+            return {PHASE_TWO_KEY: True}
+        return {}
 
     def _stopped(self) -> bool:
         return self._driver.finished

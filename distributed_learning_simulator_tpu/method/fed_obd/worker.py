@@ -56,6 +56,11 @@ class FedOBDWorker(AggregationWorker):
     def _load_result_from_server(self, result: Message) -> None:
         if PHASE_TWO_KEY in result.other_data:
             assert isinstance(result, ParameterMessage)
+            if getattr(result, "is_initial", False) and "round" in result.other_data:
+                # resumed directly into phase 2: the round annotation must
+                # land BEFORE _enter_epoch_tune derives config.round from
+                # _round_num, or the worker would stop before training
+                self._round_num = result.other_data["round"]
             self._enter_epoch_tune()
         super()._load_result_from_server(result=result)
 

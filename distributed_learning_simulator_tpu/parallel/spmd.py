@@ -458,34 +458,11 @@ class SpmdFedAvgSession:
         config = self.config
         resume_dir = config.algorithm_kwargs.get("resume_dir")
         if resume_dir:
-            model_dir = os.path.join(resume_dir, "aggregated_model")
-            rounds = (
-                sorted(
-                    int(name.split("_")[1].split(".")[0])
-                    for name in os.listdir(model_dir)
-                    if name.startswith("round_") and name.endswith(".npz")
-                )
-                if os.path.isdir(model_dir)
-                else []
-            )
-            record = os.path.join(resume_dir, "server", "round_record.json")
-            recorded: dict[int, dict] = {}
-            if os.path.isfile(record):
-                with open(record, encoding="utf8") as f:
-                    recorded = {int(k): v for k, v in json.load(f).items()}
-            # the round checkpoint is written asynchronously BEFORE the
-            # round's record entry — a crash mid-evaluation leaves a
-            # trailing round_N.npz with no stats row.  Resume only from
-            # rounds that have both, so stats/best-model bookkeeping stay
-            # complete (the orphan npz is simply re-trained).
-            rounds = [n for n in rounds if n in recorded]
-            if rounds:
-                last = rounds[-1]
-                with np.load(os.path.join(model_dir, f"round_{last}.npz")) as blob:
-                    params = {k: blob[k] for k in blob.files}
-                for key, value in recorded.items():
-                    if key <= last:
-                        self._stat[key] = value
+            from ..util.resume import load_resume_state
+
+            params, stats, last = load_resume_state(resume_dir)
+            if params is not None:
+                self._stat.update(stats)
                 self._max_acc = max(
                     s["test_accuracy"] for s in self._stat.values()
                 )

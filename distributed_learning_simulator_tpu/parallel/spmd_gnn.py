@@ -405,22 +405,43 @@ class SpmdFedGNNSession:
         return fn
 
     # ------------------------------------------------------------------
+    def _init_global_params(self):
+        """Fresh init, or resume from a previous session's latest
+        ``aggregated_model/round_N.npz`` + ``round_record.json`` (same
+        semantics as ``SpmdFedAvgSession._init_global_params``)."""
+        config = self.config
+        resume_dir = config.algorithm_kwargs.get("resume_dir")
+        if not resume_dir:
+            return self.engine.init_params(config.seed), 1
+        from ..util.resume import load_resume_state
+
+        params, stats, last = load_resume_state(resume_dir)
+        assert params is not None, f"nothing resumable under {resume_dir}"
+        self._stat = stats
+        self._max_acc = max(
+            (s.get("test_accuracy", 0.0) for s in self._stat.values()),
+            default=0.0,
+        )
+        get_logger().info("resumed graph session from %s round %d", resume_dir, last)
+        return params, last + 1
+
     def run(self) -> dict:
         config = self.config
         save_dir = os.path.join(config.save_dir, "server")
         os.makedirs(save_dir, exist_ok=True)
-        global_params = put_sharded(
-            self.engine.init_params(config.seed), self._replicated
-        )
+        init_params, start_round = self._init_global_params()
+        global_params = put_sharded(init_params, self._replicated)
         weights = put_sharded(
             self._dataset_sizes, self._client_sharding
         )
         rng = jax.random.PRNGKey(config.seed)
+        for _ in range(start_round - 1):  # keep the rng stream aligned
+            rng, _unused = jax.random.split(rng)
         test_batch = make_graph_batch(self.dc.get_dataset(Phase.Test))
         model_dir = os.path.join(config.save_dir, "aggregated_model")
         os.makedirs(model_dir, exist_ok=True)
         with self._ckpt:  # flush async round checkpoints at exit
-            for round_number in range(1, config.round + 1):
+            for round_number in range(start_round, config.round + 1):
                 self._before_round(round_number)
                 rng, round_rng = jax.random.split(rng)
                 client_rngs = put_sharded(

@@ -304,6 +304,75 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         return fn
 
     # ------------------------------------------------------------------
+    def _try_resume_obd(self, driver) -> tuple[dict, int, int]:
+        """(initial params, aggregations already done, phase-1 rounds done).
+
+        ``algorithm_kwargs.resume_dir`` restores the round record and the
+        latest round checkpoint, then fast-forwards the phase driver by
+        REPLAYING its own transition rules over the recorded aggregates
+        (each entry carries the phase that produced it — asserted during
+        the replay).  Documented resume deviations, matching the threaded
+        server's resume semantics: clients restart from the EXACT aggregate
+        rather than the quantized broadcast, and the phase-2 optimizer
+        continuation restarts at the resume point."""
+        config = self.config
+        resume_dir = config.algorithm_kwargs.get("resume_dir")
+        if not resume_dir:
+            return self.engine.init_params(config.seed), 0, 0
+        from ..util.resume import load_resume_state
+
+        params, entries, _last = load_resume_state(resume_dir)
+        assert params is not None, f"nothing resumable under {resume_dir}"
+        self._stat = {}
+        phase1_ticks = 0
+        dropped = False
+        for key in sorted(entries):
+            entry = entries[key]
+            self._stat[key] = entry
+            spec = driver.phase
+            if spec is None:
+                break
+            recorded_phase = entry.get("phase", "")
+            if recorded_phase and recorded_phase != spec.name:
+                # the record diverges from the NEW schedule here (e.g. the
+                # round budget was raised: the old run had already switched
+                # to epoch_tune) — keep the consistent prefix, drop the rest
+                del self._stat[key]
+                dropped = True
+                get_logger().info(
+                    "resume: dropping recorded aggregates from %d on "
+                    "(%s under the old schedule, %s under the new)",
+                    key,
+                    recorded_phase,
+                    spec.name,
+                )
+                break
+            if spec.block_dropout:
+                phase1_ticks += 1
+            improved = True
+            if driver.early_stop:
+                improved = self._has_improvement()
+            driver.after_aggregate(improved=improved, check_acc=spec.check_acc)
+        if dropped and self._stat:
+            # training must continue from the last KEPT aggregate, not the
+            # dropped schedule's final params (stat key == round_N.npz name)
+            from ..util.resume import load_round_checkpoint
+
+            kept = load_round_checkpoint(resume_dir, max(self._stat))
+            if kept is not None:
+                params = kept
+        self._max_acc = max(
+            (s.get("test_accuracy", 0.0) for s in self._stat.values()),
+            default=0.0,
+        )
+        get_logger().info(
+            "resumed fed_obd from %s: %d aggregates replayed, phase now %s",
+            resume_dir,
+            len(self._stat),
+            driver.phase.name if driver.phase else "finished",
+        )
+        return params, len(self._stat), phase1_ticks
+
     def _all_weights(self) -> np.ndarray:
         weights = np.asarray(self._dataset_sizes, np.float32).copy()
         weights[self.config.worker_number :] = 0.0
@@ -319,10 +388,11 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         save_dir = os.path.join(config.save_dir, "server")
         os.makedirs(save_dir, exist_ok=True)
         driver = ObdRoundDriver.from_config(config)
-        train_params = put_sharded(
-            self.engine.init_params(config.seed), self._replicated
-        )
+        init_params, resumed_aggs, resumed_phase1 = self._try_resume_obd(driver)
+        train_params = put_sharded(init_params, self._replicated)
         rng = jax.random.PRNGKey(config.seed)
+        for _ in range(resumed_aggs):  # keep the rng stream aligned
+            rng, _r, _b = jax.random.split(rng, 3)
 
         opt_state_s = None  # per-slot optimizer states, carried round-to-round
 
@@ -351,7 +421,7 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 k: float(np.asarray(v)) for k, v in metrics.items()
             }
 
-        tick = 0
+        tick = resumed_phase1  # client-selection stream continues
         with self._ckpt:  # flush async round checkpoints at exit
             while not driver.finished:
                 spec = driver.phase
@@ -389,7 +459,9 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                     phase="eval",
                     round_number=stat_key,
                 )  # phase 2: check_acc semantics
-                self._record_obd(stat_key, metric, met, exact, save_dir)
+                self._record_obd(
+                    stat_key, metric, met, exact, save_dir, spec.name
+                )
                 improved = True
                 if driver.early_stop:
                     improved = self._has_improvement()
@@ -406,7 +478,9 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         return {"performance": self._stat}
 
     # ------------------------------------------------------------------
-    def _record_obd(self, stat_key, metric, round_metrics, exact, save_dir):
+    def _record_obd(
+        self, stat_key, metric, round_metrics, exact, save_dir, phase_name=""
+    ):
         mb = 1 / 8e6
         self._record(
             stat_key,
@@ -416,6 +490,9 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             extra={
                 "received_mb": round_metrics["upload_bits"] * mb,
                 "sent_mb": round_metrics["bcast_bits"] * mb,
+                # which phase produced this aggregate — lets a resume replay
+                # the driver's transitions from the record alone
+                "phase": phase_name,
             },
         )
         if round_metrics["upload_bits"]:

@@ -72,30 +72,17 @@ class AggregationServer(Server):
         resume_dir = self.config.algorithm_kwargs.get("resume_dir")
         if not resume_dir:
             return None
-        model_dir = os.path.join(resume_dir, "aggregated_model")
-        if not os.path.isdir(model_dir):
-            get_logger().warning("resume_dir has no aggregated_model: %s", resume_dir)
+        from ..util.resume import load_resume_state
+
+        resumed_params, stats, last_round = load_resume_state(resume_dir)
+        if resumed_params is None:
+            get_logger().warning("nothing resumable under %s", resume_dir)
             return None
-        rounds = sorted(
-            int(name.split("_")[1].split(".")[0])
-            for name in os.listdir(model_dir)
-            if name.startswith("round_") and name.endswith(".npz")
-        )
-        if not rounds:
-            return None
-        last_round = rounds[-1]
-        with np.load(os.path.join(model_dir, f"round_{last_round}.npz")) as blob:
-            resumed_params = {k: blob[k] for k in blob.files}
-        record_path = os.path.join(resume_dir, "server", "round_record.json")
-        if os.path.isfile(record_path):
-            with open(record_path, encoding="utf8") as f:
-                for key, value in json.load(f).items():
-                    if int(key) <= last_round:
-                        self.__stat[int(key)] = value
-            if self.__stat:
-                restored_max = max(t["test_accuracy"] for t in self.__stat.values())
-                self.__best_acc = restored_max
-                self.__max_acc = restored_max
+        self.__stat.update(stats)
+        if self.__stat:
+            restored_max = max(t["test_accuracy"] for t in self.__stat.values())
+            self.__best_acc = restored_max
+            self.__max_acc = restored_max
         self._round_number = last_round + 1
         get_logger().info("resumed from %s at round %d", resume_dir, self._round_number)
         return resumed_params
@@ -106,12 +93,16 @@ class AggregationServer(Server):
             other_data: dict = {"init": True}
             if self._round_number > 1:  # resumed: tell workers where we are
                 other_data["round"] = self._round_number
+            other_data.update(self._init_annotations())
             self._send_result(
                 ParameterMessage(
                     in_round=True,
                     parameter=init_model,
                     other_data=other_data,
                     is_initial=True,
+                    # a resume of an already-complete schedule has nothing
+                    # to run: the init itself tells workers to stop
+                    end_training=self._stopped(),
                 )
             )
 
@@ -147,7 +138,9 @@ class AggregationServer(Server):
         elif self._compute_stat and "init" not in result.other_data:
             self.__record_compute_stat(result.parameter)
             self._maybe_early_stop(result)
-        elif result.end_training:
+        elif result.end_training and "init" not in result.other_data:
+            # (a resumed-complete run's init carries end_training — that is
+            # not a round and must not append a phantom record row)
             self.__record_compute_stat(result.parameter)
         model_path = os.path.join(
             self.config.save_dir, "aggregated_model", f"round_{self._round_number}.npz"
@@ -171,6 +164,15 @@ class AggregationServer(Server):
     def _get_stat_key(self) -> int:
         return self._round_number
 
+    def _annotate_stat(self, round_stat: dict) -> None:
+        """Subclass hook: extra fields on each round record (FedOBD tags
+        the producing phase so a resume can replay its driver)."""
+
+    def _init_annotations(self) -> dict:
+        """Subclass hook: extra ``other_data`` on the init broadcast (FedOBD
+        announces a resumed phase-2 state to freshly started workers)."""
+        return {}
+
     def __record_compute_stat(
         self, parameter_dict: Params, keep_performance_logger: bool = True
     ) -> None:
@@ -191,6 +193,7 @@ class AggregationServer(Server):
         round_stat["sent_mb"] = (self.sent_bytes - self.__round_start_bytes[1]) / 1e6
         self.__round_start = now
         self.__round_start_bytes = (self.received_bytes, self.sent_bytes)
+        self._annotate_stat(round_stat)
         key = self._get_stat_key()
         assert key not in self.__stat
         self.__stat[key] = round_stat

@@ -1,0 +1,67 @@
+"""Shared resume-state discovery.
+
+One definition of "what can be resumed" for every executor and method
+(threaded server, SPMD fed_avg/GNN/OBD sessions): the latest round whose
+checkpoint AND record row both exist.
+
+The round checkpoint is written asynchronously BEFORE the round's record
+entry (and the threaded path records before it caches) — a crash in that
+window leaves one side orphaned.  Resuming only from rounds that have both
+keeps stats/best-model bookkeeping complete; the orphan is simply
+re-trained.
+"""
+
+import json
+import os
+
+import numpy as np
+
+
+def load_resume_state(
+    resume_dir: str,
+) -> tuple[dict | None, dict[int, dict], int]:
+    """Return ``(params, recorded_stats, last_round)`` for ``resume_dir``.
+
+    ``params`` is the round-``last_round`` checkpoint; ``recorded_stats``
+    are the int-keyed record rows with key ≤ ``last_round`` (plus the
+    round-0 init row when present).  ``(None, {}, 0)`` when nothing
+    resumable exists.
+    """
+    model_dir = os.path.join(resume_dir, "aggregated_model")
+    rounds = (
+        sorted(
+            int(name.split("_")[1].split(".")[0])
+            for name in os.listdir(model_dir)
+            if name.startswith("round_") and name.endswith(".npz")
+        )
+        if os.path.isdir(model_dir)
+        else []
+    )
+    recorded: dict[int, dict] = {}
+    record_path = os.path.join(resume_dir, "server", "round_record.json")
+    if os.path.isfile(record_path):
+        with open(record_path, encoding="utf8") as f:
+            recorded = {int(k): v for k, v in json.load(f).items()}
+    rounds = [n for n in rounds if n in recorded]
+    if not rounds:
+        return None, {}, 0
+    last = rounds[-1]
+    with np.load(os.path.join(model_dir, f"round_{last}.npz")) as blob:
+        params = {k: blob[k] for k in blob.files}
+    stats = {k: v for k, v in recorded.items() if k <= last}
+    return params, stats, last
+
+
+def load_round_checkpoint(resume_dir: str, round_number: int) -> dict | None:
+    """Load one specific round checkpoint (e.g. the last KEPT round after a
+    resume replay dropped a superseded tail)."""
+    path = os.path.join(
+        resume_dir, "aggregated_model", f"round_{round_number}.npz"
+    )
+    if not os.path.isfile(path):
+        return None
+    with np.load(path) as blob:
+        return {k: blob[k] for k in blob.files}
+
+
+__all__ = ["load_resume_state", "load_round_checkpoint"]

@@ -73,7 +73,10 @@ class AggregationWorker(Client):
         if not self._choose_model_by_validation:
             dc.remove_dataset(phase=MachineLearningPhase.Validation)
         if self.config.distribute_init_parameters:
-            self._get_result_from_server()
+            try:
+                self._get_result_from_server()
+            except StopExecutingException:
+                return  # init carried end_training (resumed-complete run)
             if self._stopped():
                 return
         self._register_aggregation()

@@ -28,7 +28,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..message import Message
-from ..ml_type import ExecutorHookPoint, MachineLearningPhase
+from ..ml_type import (
+    ExecutorHookPoint,
+    MachineLearningPhase,
+    StopExecutingException,
+)
 from ..ops.pytree import param_nbytes, unflatten_nested
 from ..utils.logging import get_logger
 from .aggregation_worker import AggregationWorker
@@ -60,7 +64,10 @@ class GraphWorker(AggregationWorker):
         dc.remove_dataset(phase=MachineLearningPhase.Test)
         dc.remove_dataset(phase=MachineLearningPhase.Validation)
         if self.config.distribute_init_parameters:
-            self._get_result_from_server()
+            try:
+                self._get_result_from_server()
+            except StopExecutingException:
+                return  # init carried end_training (resumed-complete run)
             if self._stopped():
                 return
         self._exchange_training_node_indices()

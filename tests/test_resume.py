@@ -44,3 +44,242 @@ def test_spmd_resume_from_previous_session(tmp_session_dir):
     assert set(result2["performance"]) == {1, 2, 3, 4}
     assert result2["performance"][1] == result1["performance"][1]
     assert result2["performance"][2] == result1["performance"][2]
+
+
+def test_spmd_gnn_resume(tmp_session_dir):
+    """SpmdFedGNNSession resumes from a previous session's round
+    checkpoints (round 3 extension: resume beyond the fed_avg family)."""
+    from distributed_learning_simulator_tpu.config import (
+        DistributedTrainingConfig,
+    )
+
+    def gnn_config(**overrides):
+        config = DistributedTrainingConfig(
+            dataset_name="Cora",
+            model_name="TwoGCN",
+            distributed_algorithm="fed_gnn",
+            executor="spmd",
+            worker_number=2,
+            round=2,
+            epoch=1,
+            learning_rate=0.01,
+            algorithm_kwargs={"share_feature": True},
+        )
+        for key, value in overrides.items():
+            setattr(config, key, value)
+        return config
+
+    first = gnn_config()
+    first.load_config_and_process()
+    result1 = train(first)
+    assert set(result1["performance"]) == {1, 2}
+
+    resumed = gnn_config(
+        round=4,
+        algorithm_kwargs={"share_feature": True, "resume_dir": first.save_dir},
+    )
+    resumed.load_config_and_process()
+    result2 = train(resumed)
+    assert set(result2["performance"]) == {1, 2, 3, 4}
+    assert result2["performance"][1] == result1["performance"][1]
+
+
+def test_spmd_obd_resume(tmp_session_dir):
+    """SpmdFedOBDSession resumes mid-schedule: the phase driver is
+    fast-forwarded by replaying its transition rules over the recorded
+    aggregates, the client-selection and rng streams continue, and the
+    restored rounds are reported verbatim."""
+
+    def obd_config(**overrides):
+        return _config(
+            distributed_algorithm="fed_obd",
+            executor="spmd",
+            worker_number=4,
+            batch_size=16,
+            epoch=1,
+            dataset_kwargs={"train_size": 128, "val_size": 16, "test_size": 32},
+            algorithm_kwargs={
+                "dropout_rate": 0.3,
+                "second_phase_epoch": 2,
+                "early_stop": False,
+            },
+            endpoint_kwargs={
+                "server": {"weight": 0.01},
+                "worker": {"weight": 0.01},
+            },
+            **overrides,
+        )
+
+    # full run: 2 phase-1 rounds + 2 phase-2 epochs = 4 aggregates
+    first = obd_config(round=2)
+    first.load_config_and_process()
+    result1 = train(first)
+    assert set(result1["performance"]) == {1, 2, 3, 4}
+
+    # resume from the SAME record with a LARGER round budget: rounds 1-2
+    # restore verbatim, the driver replay lands in phase 1 with 2 of 4
+    # rounds consumed, and the run continues to the full new schedule
+    resumed = obd_config(round=4)
+    resumed.algorithm_kwargs["resume_dir"] = first.save_dir
+    resumed.load_config_and_process()
+    result2 = train(resumed)
+    stats = result2["performance"]
+    # the 2 phase-1 aggregates restore verbatim; the old run's phase-2
+    # entries (3, 4) belong to the superseded schedule and are dropped; the
+    # new schedule continues phase 1 (rounds 3-4) then phase 2 (5-6)
+    assert set(stats) == {1, 2, 3, 4, 5, 6}
+    assert stats[1] == result1["performance"][1]
+    assert stats[2] == result1["performance"][2]
+    assert stats[3]["phase"] == "block_dropout_rounds"
+    assert stats[5]["phase"] == "epoch_tune"
+
+
+def test_spmd_obd_resume_of_finished_run_is_noop(tmp_session_dir):
+    """Resuming a COMPLETED schedule replays to 'finished' and returns the
+    restored stats without launching new rounds."""
+
+    first = _config(
+        distributed_algorithm="fed_obd",
+        executor="spmd",
+        worker_number=2,
+        round=1,
+        batch_size=16,
+        dataset_kwargs={"train_size": 64, "val_size": 16, "test_size": 32},
+        algorithm_kwargs={
+            "dropout_rate": 0.3,
+            "second_phase_epoch": 1,
+            "early_stop": False,
+        },
+        endpoint_kwargs={"server": {"weight": 0.01}, "worker": {"weight": 0.01}},
+    )
+    first.load_config_and_process()
+    result1 = train(first)
+
+    resumed = _config(
+        distributed_algorithm="fed_obd",
+        executor="spmd",
+        worker_number=2,
+        round=1,
+        batch_size=16,
+        dataset_kwargs={"train_size": 64, "val_size": 16, "test_size": 32},
+        algorithm_kwargs={
+            "dropout_rate": 0.3,
+            "second_phase_epoch": 1,
+            "early_stop": False,
+            "resume_dir": first.save_dir,
+        },
+        endpoint_kwargs={"server": {"weight": 0.01}, "worker": {"weight": 0.01}},
+    )
+    resumed.load_config_and_process()
+    result2 = train(resumed)
+    assert result2["performance"] == result1["performance"]
+
+
+def test_threaded_obd_resume_fast_forwards_driver(tmp_session_dir):
+    """Threaded fed_obd resume replays the phase driver over the restored
+    record (a fresh driver would re-run the whole phase-1 budget)."""
+
+    def obd_config(**overrides):
+        return _config(
+            distributed_algorithm="fed_obd",
+            executor="sequential",
+            worker_number=2,
+            batch_size=16,
+            epoch=1,
+            dataset_kwargs={"train_size": 64, "val_size": 16, "test_size": 32},
+            algorithm_kwargs={
+                "dropout_rate": 0.3,
+                "second_phase_epoch": 1,
+                "early_stop": False,
+            },
+            endpoint_kwargs={
+                "server": {"weight": 0.01},
+                "worker": {"weight": 0.01},
+            },
+            **overrides,
+        )
+
+    first = obd_config(round=1)
+    first.load_config_and_process()
+    result1 = train(first)
+    stats1 = result1["performance"]
+    assert {k: v.get("phase") for k, v in stats1.items() if k > 0} == {
+        1: "block_dropout_rounds",
+        2: "epoch_tune",
+    }
+
+    # raised budget: the phase-1 prefix survives, the superseded phase-2
+    # entry is dropped, phase 1 continues then phase 2 re-runs
+    resumed = obd_config(round=3)
+    resumed.algorithm_kwargs["resume_dir"] = first.save_dir
+    resumed.load_config_and_process()
+    result2 = train(resumed)
+    stats2 = result2["performance"]
+    phases = {k: v.get("phase") for k, v in stats2.items() if k > 0}
+    assert phases[1] == "block_dropout_rounds"
+    assert stats2[1] == stats1[1]
+    assert list(sorted(phases.values())).count("block_dropout_rounds") == 3
+    assert "epoch_tune" in phases.values()
+
+
+def test_threaded_obd_resume_into_phase2(tmp_session_dir):
+    """Resume landing mid-phase-2: the init broadcast carries the
+    phase-two annotation AND the round, workers adopt the epoch-tune spec
+    without stopping early, and the remaining phase-2 budget completes."""
+    import json
+    import shutil
+
+    def obd_config(**overrides):
+        return _config(
+            distributed_algorithm="fed_obd",
+            executor="sequential",
+            worker_number=2,
+            batch_size=16,
+            epoch=1,
+            dataset_kwargs={"train_size": 64, "val_size": 16, "test_size": 32},
+            algorithm_kwargs={
+                "dropout_rate": 0.3,
+                "second_phase_epoch": 2,
+                "early_stop": False,
+            },
+            endpoint_kwargs={
+                "server": {"weight": 0.01},
+                "worker": {"weight": 0.01},
+            },
+            **overrides,
+        )
+
+    first = obd_config(round=1)
+    first.load_config_and_process()
+    result1 = train(first)
+    stats1 = result1["performance"]
+    # 1 phase-1 round + 2 phase-2 epochs
+    assert {k: v.get("phase") for k, v in stats1.items() if k > 0} == {
+        1: "block_dropout_rounds",
+        2: "epoch_tune",
+        3: "epoch_tune",
+    }
+
+    # simulate a crash after the FIRST phase-2 aggregate: truncate the
+    # record and checkpoints to entries 1-2
+    record_path = os.path.join(first.save_dir, "server", "round_record.json")
+    with open(record_path, encoding="utf8") as f:
+        record = {int(k): v for k, v in json.load(f).items()}
+    record.pop(3)
+    with open(record_path, "wt", encoding="utf8") as f:
+        json.dump(record, f)
+    npz3 = os.path.join(first.save_dir, "aggregated_model", "round_3.npz")
+    if os.path.isfile(npz3):
+        os.remove(npz3)
+
+    resumed = obd_config(round=1)
+    resumed.algorithm_kwargs["resume_dir"] = first.save_dir
+    resumed.load_config_and_process()
+    result2 = train(resumed)
+    stats2 = result2["performance"]
+    phases = {k: v.get("phase") for k, v in stats2.items() if k > 0}
+    assert phases[1] == "block_dropout_rounds"
+    assert phases[2] == "epoch_tune"
+    assert stats2[1] == stats1[1] and stats2[2] == stats1[2]
+    # the remaining phase-2 epoch ran
+    assert phases.get(3) == "epoch_tune"
