@@ -120,6 +120,43 @@ class ObdRoundDriver:
     def stop_now(self) -> None:
         self._schedule.clear()
 
+    def fast_forward(self, phase_names: list[str]) -> int:
+        """Resume support: advance the driver to match a RECORDED sequence
+        of per-aggregate phase names (one source of truth for both
+        executors' resume paths).
+
+        The record already reflects whatever plateau/budget decisions the
+        original run made, so no ``improved`` guessing happens here: a
+        recorded name equal to the current phase consumes one tick; a name
+        equal to the NEXT scheduled phase mid-budget follows the recorded
+        switch ONLY when ``early_stop`` could have produced it (a plateau
+        switch) — otherwise a mid-budget switch can only come from a
+        SUPERSEDED schedule (e.g. the round budget was raised since) and
+        the replay stops there.  Returns how many entries were consumed
+        (the caller drops the rest)."""
+        kept = 0
+        for name in phase_names:
+            if self.finished:
+                break
+            # untagged rows (records predating phase tagging) count against
+            # the current phase
+            if name and name != self.phase.name:
+                if (
+                    self.early_stop
+                    and len(self._schedule) > 1
+                    and name == self._schedule[1].name
+                ):
+                    self._schedule.pop(0)
+                    self._tick = 0
+                else:
+                    break
+            self._tick += 1
+            kept += 1
+            if self._tick >= self.budget():
+                self._schedule.pop(0)
+                self._tick = 0
+        return kept
+
     def after_aggregate(
         self,
         *,

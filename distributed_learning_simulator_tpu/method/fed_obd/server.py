@@ -40,54 +40,39 @@ class FedOBDServer(AggregationServer):
         resumed = super()._try_resume()
         if resumed is None:
             return None
+        from .driver import BLOCK_DROPOUT_ROUNDS
+
         stats = self.performance_stat
-        phase1_kept = 0
-        dropped_from = None
-        replayed_accs: list[float] = []
-        for key in sorted(k for k in stats if k > 0):
-            spec = self._driver.phase
-            if spec is None:
-                break
-            recorded_phase = stats[key].get("phase", "")
-            if recorded_phase and recorded_phase != spec.name:
-                # record diverges from the new schedule (e.g. raised round
-                # budget): keep the consistent prefix, drop the whole tail
-                dropped_from = key
-                for stale in [k for k in stats if k >= key]:
-                    del stats[stale]
-                get_logger().info(
-                    "resume: dropping recorded aggregates from %d on (%s "
-                    "under the old schedule, %s under the new)",
-                    key,
-                    recorded_phase,
-                    spec.name,
-                )
-                break
-            if spec.block_dropout:
-                phase1_kept += 1
-            replayed_accs.append(stats[key].get("test_accuracy", 0.0))
-            # plateau over the GROWING prefix, not the fully-restored
-            # record (_convergent's watermark was already pre-set to the
-            # restored maximum and would call every replayed entry a
-            # plateau tick)
-            improved = True
-            if self._driver.early_stop and len(replayed_accs) >= 6:
-                improved = max(replayed_accs[-5:]) > max(replayed_accs[:-5])
-            self._driver.after_aggregate(
-                improved=improved, check_acc=spec.check_acc
+        keys = sorted(k for k in stats if k > 0)
+        names = [stats[k].get("phase", "") for k in keys]
+        # replay the RECORDED phase sequence through the driver — one
+        # definition of the transition rules (driver.fast_forward), no
+        # plateau re-guessing; a tail from a superseded schedule is dropped
+        kept = self._driver.fast_forward(names)
+        for stale in keys[kept:]:
+            del stats[stale]
+        if kept < len(keys):
+            get_logger().info(
+                "resume: dropping %d recorded aggregates from a superseded "
+                "schedule (from key %d on)",
+                len(keys) - kept,
+                keys[kept],
             )
+        phase1_kept = sum(
+            1 for n in names[:kept] if n in ("", BLOCK_DROPOUT_ROUNDS.name)
+        )
         # the base resume numbered the round after the LATEST checkpoint;
         # the replayed schedule may have dropped that tail — round and
-        # params must follow the kept prefix (stat key == round_N.npz name)
+        # params must follow the kept prefix (stat key == checkpoint key)
         self._round_number = phase1_kept + 1
-        if dropped_from is not None and stats:
+        if kept < len(keys) and kept:
             from ...util.resume import load_round_checkpoint
 
-            kept = load_round_checkpoint(
-                self.config.algorithm_kwargs["resume_dir"], max(stats)
+            kept_params = load_round_checkpoint(
+                self.config.algorithm_kwargs["resume_dir"], keys[kept - 1]
             )
-            if kept is not None:
-                resumed = kept
+            if kept_params is not None:
+                resumed = kept_params
         get_logger().info(
             "resume: fed_obd driver fast-forwarded to %s (round -> %d)",
             self._driver.phase.name if self._driver.phase else "finished",

@@ -493,6 +493,8 @@ class SpmdFedAvgSession:
         save_dir = os.path.join(config.save_dir, "server")
         os.makedirs(save_dir, exist_ok=True)
         rng = jax.random.PRNGKey(config.seed)
+        for _ in range(start_round - 1):  # resume: keep the rng stream aligned
+            rng, _unused = jax.random.split(rng)
         param_mb = sum(
             int(np.prod(v.shape)) * 4 for v in jax.tree.leaves(global_params)
         ) / 1e6
@@ -778,13 +780,15 @@ class SpmdSignSGDSession:
                 phase="round",
                 round_number=round_number,
             )
+            def guarded_eval(p=params):
+                metric = summarize_metrics(self.engine.evaluate(p, batches))
+                metric.update(
+                    maybe_slow_metrics(self.config, self.engine, p, batches)
+                )
+                return metric
+
             metric = self._watchdog.call(
-                lambda p=params: summarize_metrics(self.engine.evaluate(p, batches)),
-                phase="eval",
-                round_number=round_number,
-            )
-            metric.update(
-                maybe_slow_metrics(self.config, self.engine, params, batches)
+                guarded_eval, phase="eval", round_number=round_number
             )
             count = np.maximum(np.asarray(epoch_metrics["count"]), 1.0)
             self._stat[round_number] = {

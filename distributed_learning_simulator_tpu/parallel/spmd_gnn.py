@@ -416,7 +416,11 @@ class SpmdFedGNNSession:
         from ..util.resume import load_resume_state
 
         params, stats, last = load_resume_state(resume_dir)
-        assert params is not None, f"nothing resumable under {resume_dir}"
+        if params is None:
+            get_logger().warning(
+                "nothing resumable under %s; starting fresh", resume_dir
+            )
+            return self.engine.init_params(config.seed), 1
         self._stat = stats
         self._max_acc = max(
             (s.get("test_accuracy", 0.0) for s in self._stat.values()),

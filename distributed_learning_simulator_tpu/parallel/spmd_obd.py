@@ -319,48 +319,43 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         resume_dir = config.algorithm_kwargs.get("resume_dir")
         if not resume_dir:
             return self.engine.init_params(config.seed), 0, 0
+        from ..method.fed_obd.driver import BLOCK_DROPOUT_ROUNDS
         from ..util.resume import load_resume_state
 
         params, entries, _last = load_resume_state(resume_dir)
-        assert params is not None, f"nothing resumable under {resume_dir}"
-        self._stat = {}
-        phase1_ticks = 0
-        dropped = False
-        for key in sorted(entries):
-            entry = entries[key]
-            self._stat[key] = entry
-            spec = driver.phase
-            if spec is None:
-                break
-            recorded_phase = entry.get("phase", "")
-            if recorded_phase and recorded_phase != spec.name:
-                # the record diverges from the NEW schedule here (e.g. the
-                # round budget was raised: the old run had already switched
-                # to epoch_tune) — keep the consistent prefix, drop the rest
-                del self._stat[key]
-                dropped = True
-                get_logger().info(
-                    "resume: dropping recorded aggregates from %d on "
-                    "(%s under the old schedule, %s under the new)",
-                    key,
-                    recorded_phase,
-                    spec.name,
-                )
-                break
-            if spec.block_dropout:
-                phase1_ticks += 1
-            improved = True
-            if driver.early_stop:
-                improved = self._has_improvement()
-            driver.after_aggregate(improved=improved, check_acc=spec.check_acc)
-        if dropped and self._stat:
+        if params is None:
+            get_logger().warning(
+                "nothing resumable under %s; starting fresh", resume_dir
+            )
+            return self.engine.init_params(config.seed), 0, 0
+        # replay the RECORDED phase sequence through the driver (one
+        # definition of the transition rules — driver.fast_forward); a tail
+        # from a superseded schedule is dropped
+        keys = sorted(k for k in entries if k > 0)
+        names = [entries[k].get("phase", "") for k in keys]
+        kept = driver.fast_forward(names)
+        self._stat = {k: entries[k] for k in keys[:kept]}
+        if 0 in entries:
+            self._stat[0] = entries[0]
+        phase1_ticks = sum(
+            1 for n in names[:kept] if n in ("", BLOCK_DROPOUT_ROUNDS.name)
+        )
+        dropped = kept < len(keys)
+        if dropped:
+            get_logger().info(
+                "resume: dropping %d recorded aggregates from a superseded "
+                "schedule (from key %d on)",
+                len(keys) - kept,
+                keys[kept],
+            )
+        if dropped and kept:
             # training must continue from the last KEPT aggregate, not the
             # dropped schedule's final params (stat key == round_N.npz name)
             from ..util.resume import load_round_checkpoint
 
-            kept = load_round_checkpoint(resume_dir, max(self._stat))
-            if kept is not None:
-                params = kept
+            kept_params = load_round_checkpoint(resume_dir, keys[kept - 1])
+            if kept_params is not None:
+                params = kept_params
         self._max_acc = max(
             (s.get("test_accuracy", 0.0) for s in self._stat.values()),
             default=0.0,
@@ -368,10 +363,10 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         get_logger().info(
             "resumed fed_obd from %s: %d aggregates replayed, phase now %s",
             resume_dir,
-            len(self._stat),
+            kept,
             driver.phase.name if driver.phase else "finished",
         )
-        return params, len(self._stat), phase1_ticks
+        return params, kept, phase1_ticks
 
     def _all_weights(self) -> np.ndarray:
         weights = np.asarray(self._dataset_sizes, np.float32).copy()

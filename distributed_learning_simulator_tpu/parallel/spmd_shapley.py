@@ -233,5 +233,9 @@ class SpmdShapleySession(SpmdFedAvgSession):
                 ),
                 params_s,
             )
-            metric = self._evaluate(global_params)
+            metric = self._watchdog.call(
+                lambda gp=global_params: self._evaluate(gp),
+                phase="eval",
+                round_number=round_number,
+            )
             self._record(round_number, metric, global_params, save_dir)

@@ -142,8 +142,16 @@ class AggregationServer(Server):
             # (a resumed-complete run's init carries end_training — that is
             # not a round and must not append a phantom record row)
             self.__record_compute_stat(result.parameter)
+        # key the checkpoint by the stat row just recorded, NOT the round
+        # counter: in_round aggregates (FedOBD phase 2) freeze the counter
+        # while stat keys keep appending — counter-keyed files would
+        # overwrite each other and desync checkpoint↔record pairing on
+        # resume (stat key == round_N.npz name is the resume contract)
+        recorded_key = max(
+            (k for k in self.__stat if k > 0), default=self._round_number
+        )
         model_path = os.path.join(
-            self.config.save_dir, "aggregated_model", f"round_{self._round_number}.npz"
+            self.config.save_dir, "aggregated_model", f"round_{recorded_key}.npz"
         )
         self._model_cache.cache_parameter_dict(result.parameter, model_path)
         if self.config.checkpoint_every_round:

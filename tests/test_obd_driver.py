@@ -65,3 +65,47 @@ def test_early_stop_disabled_ignores_improved_flag():
     driver = ObdRoundDriver(total_rounds=2, second_phase_epoch=1, early_stop=False)
     assert not driver.after_aggregate(improved=False).annotations
     assert driver.phase is BLOCK_DROPOUT_ROUNDS
+
+
+def test_fast_forward_budget_switch():
+    """A recorded sequence that exhausted the round budget replays through
+    the switch and into phase 2."""
+    driver = ObdRoundDriver(total_rounds=2, second_phase_epoch=2, early_stop=False)
+    names = [BLOCK_DROPOUT_ROUNDS.name] * 2 + [EPOCH_TUNE.name]
+    assert driver.fast_forward(names) == 3
+    assert driver.phase is EPOCH_TUNE
+    # one epoch-tune tick left of the budget
+    assert driver.after_aggregate(check_acc=True).end_training
+    assert driver.finished
+
+
+def test_fast_forward_superseded_tail_dropped_without_early_stop():
+    """Mid-budget switch with early_stop disabled can only be a superseded
+    schedule (the budget was raised): the tail is not consumed."""
+    driver = ObdRoundDriver(total_rounds=4, second_phase_epoch=2, early_stop=False)
+    names = [BLOCK_DROPOUT_ROUNDS.name] * 2 + [EPOCH_TUNE.name] * 2
+    assert driver.fast_forward(names) == 2
+    assert driver.phase is BLOCK_DROPOUT_ROUNDS
+
+
+def test_fast_forward_follows_plateau_switch_with_early_stop():
+    """With early_stop the same mid-budget switch is a legitimate recorded
+    plateau transition and is followed."""
+    driver = ObdRoundDriver(total_rounds=4, second_phase_epoch=2, early_stop=True)
+    names = [BLOCK_DROPOUT_ROUNDS.name] * 2 + [EPOCH_TUNE.name]
+    assert driver.fast_forward(names) == 3
+    assert driver.phase is EPOCH_TUNE
+
+
+def test_fast_forward_untagged_rows_count_against_current_phase():
+    driver = ObdRoundDriver(total_rounds=3, second_phase_epoch=1, early_stop=False)
+    assert driver.fast_forward(["", "", ""]) == 3
+    assert driver.phase is EPOCH_TUNE
+
+
+def test_fast_forward_finished_run():
+    driver = ObdRoundDriver(total_rounds=1, second_phase_epoch=1, early_stop=False)
+    names = [BLOCK_DROPOUT_ROUNDS.name, EPOCH_TUNE.name, EPOCH_TUNE.name]
+    # the third entry has nothing left to consume
+    assert driver.fast_forward(names) == 2
+    assert driver.finished
