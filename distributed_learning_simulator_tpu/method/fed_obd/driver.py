@@ -62,6 +62,26 @@ EPOCH_TUNE = PhaseSpec(
 #: (reference ``other_data["phase_two"]``, ``fed_obd/server.py:38-44``)
 PHASE_TWO_KEY = "phase_two"
 
+
+def replay_resume(driver, entries: dict[int, dict]) -> tuple[list[int], int]:
+    """Shared resume replay for BOTH executors: feed the recorded phase
+    sequence (rows keyed > 0, in key order) through
+    :meth:`ObdRoundDriver.fast_forward`.  Returns ``(kept keys, phase-1
+    ticks)``; the caller drops rows beyond the kept prefix."""
+    from ...utils.logging import get_logger
+
+    keys = sorted(k for k in entries if k > 0)
+    names = [entries[k].get("phase", "") for k in keys]
+    kept, phase1_ticks = driver.fast_forward(names)
+    if kept < len(keys):
+        get_logger().info(
+            "resume: dropping %d recorded aggregates from a superseded "
+            "schedule (from key %d on)",
+            len(keys) - kept,
+            keys[kept],
+        )
+    return keys[:kept], phase1_ticks
+
 SPEC_BY_NAME = {spec.name: spec for spec in (BLOCK_DROPOUT_ROUNDS, EPOCH_TUNE)}
 
 
@@ -132,9 +152,13 @@ class ObdRoundDriver:
         switch ONLY when ``early_stop`` could have produced it (a plateau
         switch) — otherwise a mid-budget switch can only come from a
         SUPERSEDED schedule (e.g. the round budget was raised since) and
-        the replay stops there.  Returns how many entries were consumed
-        (the caller drops the rest)."""
+        the replay stops there.  Returns ``(consumed, phase1_ticks)`` —
+        how many entries were consumed (the caller drops the rest) and how
+        many of those counted against the block-dropout phase (the round
+        counter's resume value; attribution happens HERE because untagged
+        rows belong to whatever phase the replay was in)."""
         kept = 0
+        phase1_ticks = 0
         for name in phase_names:
             if self.finished:
                 break
@@ -150,12 +174,14 @@ class ObdRoundDriver:
                     self._tick = 0
                 else:
                     break
+            if self.phase.block_dropout:
+                phase1_ticks += 1
             self._tick += 1
             kept += 1
             if self._tick >= self.budget():
                 self._schedule.pop(0)
                 self._tick = 0
-        return kept
+        return kept, phase1_ticks
 
     def after_aggregate(
         self,

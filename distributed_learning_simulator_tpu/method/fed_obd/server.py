@@ -40,36 +40,25 @@ class FedOBDServer(AggregationServer):
         resumed = super()._try_resume()
         if resumed is None:
             return None
-        from .driver import BLOCK_DROPOUT_ROUNDS
+        from .driver import replay_resume
 
         stats = self.performance_stat
-        keys = sorted(k for k in stats if k > 0)
-        names = [stats[k].get("phase", "") for k in keys]
+        total = len([k for k in stats if k > 0])
         # replay the RECORDED phase sequence through the driver — one
-        # definition of the transition rules (driver.fast_forward), no
-        # plateau re-guessing; a tail from a superseded schedule is dropped
-        kept = self._driver.fast_forward(names)
-        for stale in keys[kept:]:
+        # definition of the transition rules (shared with the SPMD
+        # session), no plateau re-guessing; a superseded tail is dropped
+        kept_keys, phase1_kept = replay_resume(self._driver, stats)
+        for stale in [k for k in stats if k > 0 and k not in kept_keys]:
             del stats[stale]
-        if kept < len(keys):
-            get_logger().info(
-                "resume: dropping %d recorded aggregates from a superseded "
-                "schedule (from key %d on)",
-                len(keys) - kept,
-                keys[kept],
-            )
-        phase1_kept = sum(
-            1 for n in names[:kept] if n in ("", BLOCK_DROPOUT_ROUNDS.name)
-        )
         # the base resume numbered the round after the LATEST checkpoint;
         # the replayed schedule may have dropped that tail — round and
         # params must follow the kept prefix (stat key == checkpoint key)
         self._round_number = phase1_kept + 1
-        if kept < len(keys) and kept:
+        if kept_keys and len(kept_keys) < total:
             from ...util.resume import load_round_checkpoint
 
             kept_params = load_round_checkpoint(
-                self.config.algorithm_kwargs["resume_dir"], keys[kept - 1]
+                self.config.algorithm_kwargs["resume_dir"], kept_keys[-1]
             )
             if kept_params is not None:
                 resumed = kept_params

@@ -304,6 +304,54 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         return fn
 
     # ------------------------------------------------------------------
+    def _opt_state_template(self):
+        """Abstract [S, ...] optimizer-state pytree (structure + shapes,
+        nothing computed)."""
+        return jax.eval_shape(
+            lambda p: jax.vmap(
+                self.engine.optimizer.init, in_axes=None, axis_size=self.n_slots
+            )(p),
+            jax.eval_shape(lambda: self.engine.init_params(self.config.seed)),
+        )
+
+    def _save_opt_state(self, stat_key: int) -> None:
+        """Queue the per-slot optimizer states to disk, tagged with the
+        aggregate they belong to — phase-2 resume then continues momentum
+        and schedule position exactly (the SURVEY §5 TPU plan's
+        'per-client opt state' checkpoint)."""
+        leaves = jax.tree.leaves(self._opt_state_s)
+        payload = {f"leaf_{i}": leaf for i, leaf in enumerate(leaves)}
+        payload["stat_key"] = np.int64(stat_key)
+        self._ckpt.save_npz(
+            os.path.join(self.config.save_dir, "aggregated_model", "opt_state.npz"),
+            payload,
+        )
+
+    def _load_opt_state(self, resume_dir: str, expect_key: int):
+        """The saved optimizer states, or None when absent / from a
+        different aggregate than the resume point."""
+        path = os.path.join(resume_dir, "aggregated_model", "opt_state.npz")
+        if not os.path.isfile(path):
+            return None
+        with np.load(path) as blob:
+            if int(blob["stat_key"]) != expect_key:
+                return None
+            loaded = {k: blob[k] for k in blob.files if k != "stat_key"}
+        template = self._opt_state_template()
+        shapes, treedef = jax.tree.flatten(template)
+        if len(loaded) != len(shapes):
+            get_logger().warning("opt_state.npz does not match the optimizer")
+            return None
+        leaves = []
+        for i, shape in enumerate(shapes):
+            leaf = loaded[f"leaf_{i}"]
+            if tuple(leaf.shape) != tuple(shape.shape):
+                get_logger().warning("opt_state.npz leaf %d shape mismatch", i)
+                return None
+            leaves.append(leaf.astype(shape.dtype))
+        get_logger().info("restored phase-2 optimizer states (aggregate %d)", expect_key)
+        return jax.tree.unflatten(treedef, leaves)
+
     def _try_resume_obd(self, driver) -> tuple[dict, int, int]:
         """(initial params, aggregations already done, phase-1 rounds done).
 
@@ -319,7 +367,7 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         resume_dir = config.algorithm_kwargs.get("resume_dir")
         if not resume_dir:
             return self.engine.init_params(config.seed), 0, 0
-        from ..method.fed_obd.driver import BLOCK_DROPOUT_ROUNDS
+        from ..method.fed_obd.driver import replay_resume
         from ..util.resume import load_resume_state
 
         params, entries, _last = load_resume_state(resume_dir)
@@ -329,37 +377,33 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             )
             return self.engine.init_params(config.seed), 0, 0
         # replay the RECORDED phase sequence through the driver (one
-        # definition of the transition rules — driver.fast_forward); a tail
-        # from a superseded schedule is dropped
-        keys = sorted(k for k in entries if k > 0)
-        names = [entries[k].get("phase", "") for k in keys]
-        kept = driver.fast_forward(names)
-        self._stat = {k: entries[k] for k in keys[:kept]}
+        # definition of the transition rules, shared with the threaded
+        # server); a tail from a superseded schedule is dropped
+        kept_keys, phase1_ticks = replay_resume(driver, entries)
+        kept = len(kept_keys)
+        self._stat = {k: entries[k] for k in kept_keys}
         if 0 in entries:
             self._stat[0] = entries[0]
-        phase1_ticks = sum(
-            1 for n in names[:kept] if n in ("", BLOCK_DROPOUT_ROUNDS.name)
-        )
-        dropped = kept < len(keys)
-        if dropped:
-            get_logger().info(
-                "resume: dropping %d recorded aggregates from a superseded "
-                "schedule (from key %d on)",
-                len(keys) - kept,
-                keys[kept],
-            )
+        dropped = kept < len([k for k in entries if k > 0])
         if dropped and kept:
             # training must continue from the last KEPT aggregate, not the
             # dropped schedule's final params (stat key == round_N.npz name)
             from ..util.resume import load_round_checkpoint
 
-            kept_params = load_round_checkpoint(resume_dir, keys[kept - 1])
+            kept_params = load_round_checkpoint(resume_dir, kept_keys[-1])
             if kept_params is not None:
                 params = kept_params
         self._max_acc = max(
             (s.get("test_accuracy", 0.0) for s in self._stat.values()),
             default=0.0,
         )
+        # resume landing in phase 2 (or exactly at the switch) continues the
+        # optimizer states saved with the last kept aggregate
+        self._resumed_opt_state = None
+        if kept and driver.phase is not None and not driver.phase.block_dropout:
+            self._resumed_opt_state = self._load_opt_state(
+                resume_dir, kept_keys[-1]
+            )
         get_logger().info(
             "resumed fed_obd from %s: %d aggregates replayed, phase now %s",
             resume_dir,
@@ -389,7 +433,9 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         for _ in range(resumed_aggs):  # keep the rng stream aligned
             rng, _r, _b = jax.random.split(rng, 3)
 
-        opt_state_s = None  # per-slot optimizer states, carried round-to-round
+        # per-slot optimizer states, carried round-to-round (restored from
+        # opt_state.npz when the resume landed on the matching aggregate)
+        opt_state_s = getattr(self, "_resumed_opt_state", None)
 
         def step(fn, params, weights, round_number, phase_label, use_opt):
             nonlocal rng, opt_state_s
@@ -398,6 +444,12 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 jax.random.split(round_rng, self.n_slots), self._client_sharding
             )
             weights = put_sharded(weights, self._client_sharding)
+            if use_opt:
+                # opt_state_s is DONATED into the phase-2 program — a
+                # queued opt-state checkpoint fetch must win the race with
+                # XLA reusing those buffers.  Phase 1 donates only the
+                # never-saved broadcast params: no barrier needed there
+                self._ckpt.barrier()
             # distinct phase labels: phase 2 compiles its own program
             # mid-run and must get its own compile grace
             exact, bcast, opt_state_s, metrics = self._watchdog.call(
@@ -463,6 +515,10 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 decision = driver.after_aggregate(
                     improved=improved, check_acc=spec.check_acc
                 )
+                if decision.annotations or not spec.block_dropout:
+                    # the states entering phase 2 (at the switch) and after
+                    # every phase-2 epoch are what a resume needs
+                    self._save_opt_state(stat_key)
                 if decision.annotations:
                     get_logger().info(
                         "phase switch -> %s",

@@ -72,7 +72,7 @@ def test_fast_forward_budget_switch():
     the switch and into phase 2."""
     driver = ObdRoundDriver(total_rounds=2, second_phase_epoch=2, early_stop=False)
     names = [BLOCK_DROPOUT_ROUNDS.name] * 2 + [EPOCH_TUNE.name]
-    assert driver.fast_forward(names) == 3
+    assert driver.fast_forward(names) == (3, 2)
     assert driver.phase is EPOCH_TUNE
     # one epoch-tune tick left of the budget
     assert driver.after_aggregate(check_acc=True).end_training
@@ -84,7 +84,7 @@ def test_fast_forward_superseded_tail_dropped_without_early_stop():
     schedule (the budget was raised): the tail is not consumed."""
     driver = ObdRoundDriver(total_rounds=4, second_phase_epoch=2, early_stop=False)
     names = [BLOCK_DROPOUT_ROUNDS.name] * 2 + [EPOCH_TUNE.name] * 2
-    assert driver.fast_forward(names) == 2
+    assert driver.fast_forward(names) == (2, 2)
     assert driver.phase is BLOCK_DROPOUT_ROUNDS
 
 
@@ -93,13 +93,13 @@ def test_fast_forward_follows_plateau_switch_with_early_stop():
     plateau transition and is followed."""
     driver = ObdRoundDriver(total_rounds=4, second_phase_epoch=2, early_stop=True)
     names = [BLOCK_DROPOUT_ROUNDS.name] * 2 + [EPOCH_TUNE.name]
-    assert driver.fast_forward(names) == 3
+    assert driver.fast_forward(names) == (3, 2)
     assert driver.phase is EPOCH_TUNE
 
 
 def test_fast_forward_untagged_rows_count_against_current_phase():
     driver = ObdRoundDriver(total_rounds=3, second_phase_epoch=1, early_stop=False)
-    assert driver.fast_forward(["", "", ""]) == 3
+    assert driver.fast_forward(["", "", ""]) == (3, 3)
     assert driver.phase is EPOCH_TUNE
 
 
@@ -107,5 +107,14 @@ def test_fast_forward_finished_run():
     driver = ObdRoundDriver(total_rounds=1, second_phase_epoch=1, early_stop=False)
     names = [BLOCK_DROPOUT_ROUNDS.name, EPOCH_TUNE.name, EPOCH_TUNE.name]
     # the third entry has nothing left to consume
-    assert driver.fast_forward(names) == 2
+    assert driver.fast_forward(names) == (2, 1)
     assert driver.finished
+
+
+def test_fast_forward_untagged_rows_cross_phases():
+    """Legacy records (no phase tags): rows past the phase-1 budget count
+    against phase 2, so the phase-1 tick count (the resumed round number's
+    basis) is NOT inflated."""
+    driver = ObdRoundDriver(total_rounds=3, second_phase_epoch=2, early_stop=False)
+    assert driver.fast_forward(["", "", "", ""]) == (4, 3)
+    assert driver.phase is EPOCH_TUNE

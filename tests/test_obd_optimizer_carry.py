@@ -148,3 +148,44 @@ def test_phase2_trajectory_matches_threaded(tmp_session_dir):
     threaded = run("sequential")
     assert np.isfinite(spmd["test_loss"]) and np.isfinite(threaded["test_loss"])
     assert abs(spmd["test_accuracy"] - threaded["test_accuracy"]) < 0.35
+
+
+def test_phase2_resume_restores_optimizer_states(tmp_session_dir):
+    """opt_state.npz: a resume landing mid-phase-2 on the aggregate the
+    states were saved with CONTINUES momentum + schedule position (the
+    SURVEY §5 'per-client opt state' checkpoint); counts keep growing from
+    the restored value instead of restarting."""
+    session, ctx = _make_session(tmp_session_dir, rounds=1, phase2_epochs=1)
+    session.run()
+    steps = session.n_batches
+    # 1 phase-1 round + 1 phase-2 epoch, states saved tagged with the final
+    # aggregate (key 2)
+    final_counts = _counts(session._opt_state_s)
+    assert all(np.all(c == 2 * steps) for c in final_counts)
+
+    # a new session with a LARGER phase-2 budget resumes from the same
+    # record: replay keeps both aggregates, lands in phase 2 tick 1, and
+    # the saved states (tag == last kept aggregate) are restored
+    config2 = session.config.replace(save_dir=str(tmp_session_dir / "resumed"))
+    config2.algorithm_kwargs = dict(
+        config2.algorithm_kwargs,
+        second_phase_epoch=3,
+        resume_dir=session.config.save_dir,
+    )
+    from distributed_learning_simulator_tpu.parallel.spmd_obd import (
+        SpmdFedOBDSession,
+    )
+
+    resumed = SpmdFedOBDSession(
+        config2,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+    result = resumed.run()
+    assert resumed._resumed_opt_state is not None, "states were not restored"
+    # continued: (1 phase-1 + 1 restored + 2 new phase-2 epochs) x steps
+    counts = _counts(resumed._opt_state_s)
+    assert all(np.all(c == 4 * steps) for c in counts), counts
+    assert set(result["performance"]) == {1, 2, 3, 4}
