@@ -283,3 +283,41 @@ def test_threaded_obd_resume_into_phase2(tmp_session_dir):
     assert stats2[1] == stats1[1] and stats2[2] == stats1[2]
     # the remaining phase-2 epoch ran
     assert phases.get(3) == "epoch_tune"
+
+
+def test_spmd_resume_matches_uninterrupted_run(tmp_session_dir):
+    """Determinism across resume: with aligned rng streams, a run resumed
+    at round 3 produces EXACTLY the rounds an uninterrupted run produces
+    (same seeds, same selection, same shuffles)."""
+    straight = _config(
+        executor="spmd",
+        worker_number=4,
+        round=4,
+        save_dir=str(tmp_session_dir / "straight"),
+    )
+    straight.load_config_and_process()
+    result_straight = train(straight)
+
+    first = _config(
+        executor="spmd",
+        worker_number=4,
+        round=2,
+        save_dir=str(tmp_session_dir / "first"),
+    )
+    first.load_config_and_process()
+    train(first)
+    resumed = _config(
+        executor="spmd",
+        worker_number=4,
+        round=4,
+        save_dir=str(tmp_session_dir / "resumed"),
+        algorithm_kwargs={"resume_dir": first.save_dir},
+    )
+    resumed.load_config_and_process()
+    result_resumed = train(resumed)
+
+    for round_number in (3, 4):
+        a = result_straight["performance"][round_number]
+        b = result_resumed["performance"][round_number]
+        assert a["test_accuracy"] == b["test_accuracy"], round_number
+        assert a["test_loss"] == b["test_loss"], round_number
