@@ -27,6 +27,7 @@ class LongContextSelfAttention(nn.Module):
     def __call__(self, x, pad_mask):
         # deferred: models package is imported by engine, which parallel/
         # also imports (package-level cycle)
+        from ..ops.fused_attention import fused_attention, kernel_eligible
         from ..parallel.ring_attention import dense_attention, sharded_attention
 
         batch, length, _ = x.shape
@@ -34,7 +35,12 @@ class LongContextSelfAttention(nn.Module):
         qkv = nn.DenseGeneral((3, self.nhead, head_dim), name="qkv")(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if self.sp_mesh is None:
-            out = dense_attention(q, k, v, kv_mask=pad_mask)
+            if kernel_eligible(length, head_dim, q.dtype.itemsize):
+                # single-device long sequence: the Pallas fused kernel
+                # (scores never hit HBM — 1.4x+ over XLA at seq 8k)
+                out = fused_attention(q, k, v, kv_mask=pad_mask)
+            else:
+                out = dense_attention(q, k, v, kv_mask=pad_mask)
         else:
             out = sharded_attention(
                 q, k, v, self.sp_mesh, axis_name="sp", impl=self.sp_impl,
@@ -78,7 +84,11 @@ class LongContextTransformer(nn.Module):
     def __call__(self, tokens, train: bool = False):
         pad_mask = tokens != self.pad_id  # [B, L]
         x = nn.Embed(self.vocab_size, self.d_model)(tokens)
-        x = x + sinusoidal_positions(self.max_len, self.d_model)[None, : tokens.shape[1]]
+        # dtype-matched add: keep the bf16 compute path under use_amp (an
+        # f32 positional constant would promote every layer back to f32)
+        x = x + sinusoidal_positions(self.max_len, self.d_model)[
+            None, : tokens.shape[1]
+        ].astype(x.dtype)
         for _ in range(self.num_encoder_layer):
             x = LongContextEncoderLayer(
                 self.d_model, self.nhead, self.sp_mesh, self.sp_impl

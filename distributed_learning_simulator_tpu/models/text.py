@@ -55,12 +55,17 @@ class EncoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, pad_mask, train: bool = False):
+        from ..ops.fused_attention import attention_fn
+
         attn_mask = pad_mask[:, None, None, :]  # [B, 1, 1, L] keyed on keys
         y = nn.MultiHeadDotProductAttention(
             num_heads=self.nhead,
             qkv_features=self.d_model,
             deterministic=not train,
             dropout_rate=self.dropout_rate,
+            # Pallas fused attention for long sequences on TPU; flax's
+            # XLA path below the measured crossover (same param tree)
+            attention_fn=attention_fn,
         )(x, x, mask=attn_mask)
         if self.attn_out_dropout:
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
@@ -92,7 +97,12 @@ class TransformerClassifier(nn.Module):
     def __call__(self, tokens, train: bool = False):
         pad_mask = tokens != self.pad_id  # [B, L]
         x = nn.Embed(self.vocab_size, self.d_model)(tokens)
-        x = x + sinusoidal_positions(self.max_len, self.d_model)[None, : tokens.shape[1]]
+        # cast the f32 numpy constant to x's dtype: under use_amp the embed
+        # output is bf16 and an f32 addend would silently promote the whole
+        # encoder stack back to f32
+        x = x + sinusoidal_positions(self.max_len, self.d_model)[
+            None, : tokens.shape[1]
+        ].astype(x.dtype)
         for _ in range(self.num_encoder_layer):
             x = EncoderLayer(self.d_model, self.nhead, 4 * self.d_model)(
                 x, pad_mask, train=train

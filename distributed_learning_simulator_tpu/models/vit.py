@@ -43,11 +43,16 @@ class ViTBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        from ..ops.fused_attention import attention_fn
+
         y = nn.LayerNorm()(x)
         y = nn.MultiHeadDotProductAttention(
             num_heads=self.num_heads,
             deterministic=not train,
             dropout_rate=self.dropout_rate,
+            # auto-gated Pallas fused attention (no-op at ViT's seq 64,
+            # engaged for high-resolution / long-patch-sequence inputs)
+            attention_fn=attention_fn,
         )(y, y)
         x = x + nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         y = nn.LayerNorm()(x)

@@ -1,0 +1,389 @@
+"""Fused multi-head attention as a first-party Pallas TPU kernel.
+
+The reference's transformer classifiers run attention through torch's
+softmax(QK^T)V with the [B, H, Tq, Tk] score matrix materialized in HBM
+(reference models come from ``cyy_torch_text``, SURVEY.md §2.13).  This
+kernel computes the whole attention — scores, masking, softmax, and the
+value contraction — in one VMEM pass per query block, so the [Tq, Tk]
+scores never touch HBM.  It is the LONG-SEQUENCE hot op: measured on the
+v5e (BASELINE.md), XLA's batched-matmul attention is faster below
+T≈1024 (the kernel's many small grid steps lose to one fat batched
+matmul), at parity around 1–2k, and behind by 1.4×+ at 8k where score
+materialization saturates HBM — so ``attention_fn`` gates the kernel to
+``MIN_FUSED_T ≤ T ≤ MAX_FUSED_T`` and the zoo's short-sequence encoders
+(ViT seq 64, IMDB seq 300) keep the XLA path.
+
+Design (deliberately simpler than a streaming/online-softmax kernel): one
+level of blocking.  The grid is ``(batch*heads, q_blocks)``; each step
+loads one [blk, D] query block plus the FULL [T, D] key/value rows for
+that (batch, head) into VMEM and runs an exact softmax over the complete
+key axis — no streaming recurrence needed.  The query block height adapts
+to the sequence (``_pick_blk``: fat blocks at short T for fewer grid
+steps, 128-row blocks at the long end).  Full K/V rows in VMEM bound the
+fusable sequence (``MAX_FUSED_T``); beyond that the sequence-parallel
+path (``parallel/ring_attention.py``) shards T over the mesh and each
+device's local block lands back inside this bound.
+
+The backward pass is two Pallas kernels (recompute-style, the standard
+flash-attention adjoint): ``dq`` re-forms each query block's probabilities
+from the saved log-sum-exp and contracts against K/V; ``dkv`` walks key
+blocks against the full query axis.  ``delta = rowsum(dO * O)`` is a cheap
+elementwise XLA op outside the kernels.
+
+Integration: ``attention_fn`` is a drop-in for
+``flax.linen.MultiHeadDotProductAttention(attention_fn=...)`` — same
+parameter tree, kwargs filtered by signature.  It falls back to flax's
+``dot_product_attention`` whenever the kernel doesn't apply (attention-
+probability dropout active, a mask that isn't a pure key-padding mask,
+head_dim > 128, T > MAX_FUSED_T, or a non-TPU backend — the interpreter
+is far too slow for the CPU test mesh, where the XLA path is used
+instead; set ``DLS_TPU_FUSED_ATTN=interpret`` to force the kernel under
+the Pallas interpreter for kernel tests).
+"""
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+MIN_FUSED_T = 1024  # below this XLA's batched-matmul attention is faster
+MAX_FUSED_T = 8192  # full K/V rows per (batch, head) must fit VMEM
+_S_VMEM_BYTES = 2 * 1024 * 1024  # budget for one [blk, T] f32 score block
+_NEG_INF = -1e30
+
+
+def _pick_blk(t_pad: int) -> int:
+    """Largest 128-multiple row block that DIVIDES ``t_pad`` (the grid is
+    ``t_pad // blk`` steps — a non-divisor would silently drop trailing
+    query rows) and whose [blk, T] f32 score tile fits the VMEM budget —
+    fewer, fatter grid steps at short T; 128-row steps at the long end."""
+    cap = max(128, (_S_VMEM_BYTES // (t_pad * 4)) // 128 * 128)
+    blk = min(t_pad, cap)
+    while t_pad % blk:
+        blk -= 128
+    return blk
+
+
+def _mode() -> str:
+    """'tpu' (compiled), 'interpret' (forced for kernel tests), or 'off'."""
+    if jax.default_backend() == "tpu":
+        return "tpu"
+    if os.environ.get("DLS_TPU_FUSED_ATTN") == "interpret":
+        return "interpret"
+    return "off"
+
+
+def _interp(interpret: bool):
+    return pltpu.InterpretParams() if interpret else False
+
+
+# ----------------------------------------------------------------- forward
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, scale, causal):
+    blk = q_ref.shape[1]
+    q = q_ref[0]  # [blk, D]
+    k = k_ref[0]  # [T, D]
+    v = v_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [BLK, T]
+    valid = (mask_ref[0] != 0.0)  # [1, T] -> broadcasts over rows
+    if causal:
+        q_pos = pl.program_id(1) * blk + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0
+        )
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = valid & (q_pos >= k_pos)
+    s = jnp.where(valid, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # [blk, 1]
+    p = jnp.where(valid, jnp.exp(s - m), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) / jnp.maximum(l, 1e-30)
+    o_ref[0] = o.astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30))).reshape(1, -1)
+
+
+def _fwd(q3, k3, v3, mask2, heads, scale, causal, interpret):
+    bh, t, d = q3.shape
+    blk = _pick_blk(t)
+    grid = (bh, t // blk)
+    kv_spec = pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0))
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk, d), lambda b, i: (b, i, 0)),
+            kv_spec,
+            kv_spec,
+            pl.BlockSpec((1, 1, t), lambda b, i: (b // heads, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, blk, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, blk), lambda b, i: (b, 0, i)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+        ),
+        interpret=_interp(interpret),
+    )(q3, k3, v3, mask2)
+    return out, lse
+
+
+# ---------------------------------------------------------------- backward
+def _dq_kernel(
+    q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, scale, causal,
+):
+    blk = q_ref.shape[1]
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [blk, T]
+    valid = (mask_ref[0] != 0.0)
+    if causal:
+        q_pos = pl.program_id(1) * blk + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0
+        )
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = valid & (q_pos >= k_pos)
+    lse = lse_ref[0].reshape(-1, 1)  # [blk, 1]
+    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [blk, T]
+    delta = delta_ref[0].reshape(-1, 1)
+    ds = p * (dp - delta)
+    dq = jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, kmask_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, scale, causal,
+):
+    j = pl.program_id(1)
+    blk = k_ref.shape[1]
+    q = q_ref[0]  # [T, D] full query rows
+    k = k_ref[0]  # [blk, D] one key block
+    v = v_ref[0]
+    do = do_ref[0]  # [T, D]
+    s_t = jax.lax.dot_general(
+        k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [blk, T] = scores transposed (keys x queries)
+    # kmask_ref is blocked per KEY block: [1, BLK] validity of these keys
+    # (reshape the f32 mask, not the i1 compare result — Mosaic only
+    # supports minor-dim-inserting reshapes for 32-bit types)
+    valid = kmask_ref[0].reshape(-1, 1) != 0.0
+    if causal:
+        k_pos = j * blk + jax.lax.broadcasted_iota(jnp.int32, s_t.shape, 0)
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, s_t.shape, 1)
+        valid = valid & (q_pos >= k_pos)
+    lse = lse_ref[0]  # [1, T] per-query normalizers
+    p_t = jnp.where(valid, jnp.exp(s_t - lse), 0.0)  # [blk, T]
+    dv = jax.lax.dot_general(
+        p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [blk, D]
+    dp_t = jax.lax.dot_general(
+        v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [blk, T]
+    delta = delta_ref[0]  # [1, T]
+    ds_t = p_t * (dp_t - delta)
+    dk = jax.lax.dot_general(
+        ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(q3, k3, v3, mask2, out3, lse, do3, heads, scale, causal, interpret):
+    bh, t, d = q3.shape
+    delta = jnp.sum(
+        do3.astype(jnp.float32) * out3.astype(jnp.float32), axis=-1
+    )[:, None, :]
+    blk = _pick_blk(t)
+    q_spec = pl.BlockSpec((1, blk, d), lambda b, i: (b, i, 0))
+    full_spec = pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0))
+    mask_spec = pl.BlockSpec((1, 1, t), lambda b, i: (b // heads, 0, 0))
+    row_blk_spec = pl.BlockSpec((1, 1, blk), lambda b, i: (b, 0, i))
+    row_full_spec = pl.BlockSpec((1, 1, t), lambda b, i: (b, 0, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal),
+        grid=(bh, t // blk),
+        in_specs=[q_spec, full_spec, full_spec, mask_spec, q_spec,
+                  row_blk_spec, row_blk_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        interpret=_interp(interpret),
+    )(q3, k3, v3, mask2, do3, lse, delta)
+    kmask_spec = pl.BlockSpec((1, 1, blk), lambda b, j: (b // heads, 0, j))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal),
+        grid=(bh, t // blk),
+        in_specs=[full_spec,
+                  pl.BlockSpec((1, blk, d), lambda b, j: (b, j, 0)),
+                  pl.BlockSpec((1, blk, d), lambda b, j: (b, j, 0)),
+                  kmask_spec, full_spec, row_full_spec, row_full_spec],
+        out_specs=(
+            pl.BlockSpec((1, blk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, blk, d), lambda b, j: (b, j, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(k3.shape, k3.dtype),
+            jax.ShapeDtypeStruct(v3.shape, v3.dtype),
+        ),
+        interpret=_interp(interpret),
+    )(q3, k3, v3, mask2, do3, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _attend(q3, k3, v3, mask2, heads, scale, causal, interpret):
+    out, _ = _fwd(q3, k3, v3, mask2, heads, scale, causal, interpret)
+    return out
+
+
+def _attend_fwd(q3, k3, v3, mask2, heads, scale, causal, interpret):
+    out, lse = _fwd(q3, k3, v3, mask2, heads, scale, causal, interpret)
+    return out, (q3, k3, v3, mask2, out, lse)
+
+
+def _attend_bwd(heads, scale, causal, interpret, res, do3):
+    q3, k3, v3, mask2, out, lse = res
+    dq, dk, dv = _bwd(
+        q3, k3, v3, mask2, out, lse, do3, heads, scale, causal, interpret
+    )
+    return dq, dk, dv, None
+
+
+_attend.defvjp(_attend_fwd, _attend_bwd)
+
+
+def fused_attention(q, k, v, kv_mask=None, causal: bool = False):
+    """Exact fused attention.  ``q/k/v: [B, T, H, D]`` (flax head layout),
+    ``kv_mask: [B, T]`` key-padding mask (True = attend) or None.  The
+    caller is responsible for eligibility (see :func:`kernel_eligible`);
+    callers wanting automatic gating + fallback use :func:`attention_fn`."""
+    mode = _mode()
+    b, t, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    t_pad = max(128, ((t + 127) // 128) * 128)
+    # K/V loads and dq/dk/dv writes pay for padded D bytes: pad only to the
+    # MXU's minimum useful contraction width, not always to a full lane
+    d_pad = 64 if d <= 64 else LANE if d <= LANE else d
+
+    def to3(x):
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+        return jnp.pad(x, ((0, 0), (0, t_pad - t), (0, d_pad - d)))
+
+    q3, k3, v3 = to3(q), to3(k), to3(v)
+    mask = jnp.ones((b, t), jnp.float32) if kv_mask is None else kv_mask.astype(
+        jnp.float32
+    )
+    mask2 = jnp.pad(mask, ((0, 0), (0, t_pad - t)))[:, None, :]
+    out = _attend(q3, k3, v3, mask2, h, scale, causal, mode == "interpret")
+    out = out[:, :t, :d].reshape(b, h, t, d)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+_VMEM_BUDGET = 15 * 1024 * 1024  # leave headroom under the 16 MB scoped limit
+
+
+def kernel_eligible(t: int, d: int, itemsize: int = 2) -> bool:
+    """Shape/backend eligibility for the kernel itself.  The MIN_FUSED_T
+    gate is a measured perf crossover (BASELINE.md: below ~1024 XLA's
+    batched-matmul attention wins on step-overhead; at/above it the fused
+    kernel is at parity and pulls ahead with T) and applies only to the
+    compiled TPU path — the interpreter mode exists for correctness tests
+    at small shapes.  The VMEM model mirrors what Mosaic stack-allocates
+    per grid step (measured on the v5e): full K/V rows plus ~4 [blk, T]
+    f32 score-sized temporaries — f32 inputs at seq 8k exceed the 16 MB
+    scoped limit where bf16 fits, so eligibility is dtype-aware.  The
+    coefficients are anchored on measured compiles: bf16 T=8192 d=64
+    fits (14.7 MB est.), f32 T=8192 OOMs (16.8 MB est. vs the observed
+    16.5 MB allocation), bf16 T=16384 d_pad=128 OOMs."""
+    mode = _mode()
+    if mode == "off":
+        return False
+    if d > LANE or t > MAX_FUSED_T:
+        return False
+    if mode == "tpu" and t < MIN_FUSED_T:
+        return False
+    t_pad = max(128, ((t + 127) // 128) * 128)
+    d_pad = 64 if d <= 64 else LANE
+    kv_bytes = 2 * t_pad * d_pad * itemsize
+    temp_bytes = 3 * _pick_blk(t_pad) * t_pad * 4
+    return kv_bytes + temp_bytes <= _VMEM_BUDGET
+
+
+def eligible(q, mask, dropout_rate: float, deterministic: bool, k=None) -> bool:
+    """Can the Pallas kernel serve this ``attention_fn`` call?
+    (Attention-probability dropout, cross-attention, and q- or
+    head-dependent masks fall back.)"""
+    if dropout_rate > 0.0 and not deterministic:
+        return False  # in-kernel prob-dropout not implemented; XLA path
+    if q.ndim != 4 or not kernel_eligible(
+        q.shape[1], q.shape[3], q.dtype.itemsize
+    ):
+        return False
+    if k is not None and k.shape[1] != q.shape[1]:
+        return False  # cross-attention (T_kv != T_q): XLA path
+    if mask is not None and (
+        mask.ndim != 4 or mask.shape[-2] != 1 or mask.shape[-3] != 1
+    ):
+        return False  # not a pure key-padding mask (q- or head-dependent)
+    return True
+
+
+def attention_fn(
+    query,
+    key,
+    value,
+    mask=None,
+    dropout_rng=None,
+    dropout_rate: float = 0.0,
+    broadcast_dropout: bool = True,
+    deterministic: bool = True,
+    dtype=None,
+    precision=None,
+):
+    """Drop-in ``attention_fn`` for ``nn.MultiHeadDotProductAttention``:
+    routes to the fused Pallas kernel when eligible, otherwise to flax's
+    reference ``dot_product_attention`` (bit-for-bit the default path)."""
+    if eligible(query, mask, dropout_rate, deterministic, k=key):
+        kv_mask = None
+        if mask is not None:
+            # [B, 1, 1, T] (or broadcastable) key-padding mask -> [B, T]
+            kv_mask = jnp.broadcast_to(
+                mask, (query.shape[0], 1, 1, key.shape[1])
+            )[:, 0, 0, :]
+        return fused_attention(query, key, value, kv_mask=kv_mask)
+    import flax.linen as nn
+
+    return nn.dot_product_attention(
+        query,
+        key,
+        value,
+        mask=mask,
+        dropout_rng=dropout_rng,
+        dropout_rate=dropout_rate,
+        broadcast_dropout=broadcast_dropout,
+        deterministic=deterministic,
+        dtype=dtype,
+        precision=precision,
+    )

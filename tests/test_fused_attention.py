@@ -1,0 +1,194 @@
+"""Fused-attention Pallas kernel: exactness against the dense reference.
+
+Runs the kernel under the Pallas TPU interpreter on the CPU test mesh
+(``DLS_TPU_FUSED_ATTN=interpret``) — same kernel code the chip compiles,
+minus Mosaic.  The dense reference is ``parallel/ring_attention.py``'s
+``dense_attention`` (itself validated against hand math and the ring/
+Ulysses paths in ``test_spmd.py``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_learning_simulator_tpu.ops import fused_attention as fa
+from distributed_learning_simulator_tpu.parallel.ring_attention import (
+    dense_attention,
+)
+
+B, T, H, D = 2, 100, 3, 20  # deliberately unaligned: T, D exercise padding
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode(monkeypatch):
+    """Per-test (not process-wide: the interpreter would silently slow every
+    later model test) opt-in to the Pallas interpreter on the CPU mesh."""
+    monkeypatch.setenv("DLS_TPU_FUSED_ATTN", "interpret")
+
+
+@pytest.fixture(scope="module")
+def qkvm():
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    mask = jnp.asarray(rng.random((B, T)) > 0.25)
+    return q, k, v, mask
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("with_mask", [False, True])
+def test_forward_matches_dense(qkvm, causal, with_mask):
+    q, k, v, mask = qkvm
+    m = mask if with_mask else None
+    out = fa.fused_attention(q, k, v, kv_mask=m, causal=causal)
+    ref = dense_attention(q, k, v, causal=causal, kv_mask=m)
+    assert out.shape == q.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_gradients_match_dense(qkvm):
+    q, k, v, mask = qkvm
+
+    def loss(attn):
+        def f(q, k, v):
+            return jnp.sum(jnp.sin(attn(q, k, v, kv_mask=mask)))
+
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    got = loss(fa.fused_attention)
+    want = loss(lambda q, k, v, kv_mask: dense_attention(q, k, v, kv_mask=kv_mask))
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=2e-5)
+
+
+def test_vmap_over_clients(qkvm):
+    """The SPMD executor vmaps client training over stacked params; the
+    kernel must batch under vmap (pallas adds a grid dim)."""
+    q, k, v, mask = qkvm
+    qc, kc, vc = (jnp.stack([x, 2 * x]) for x in (q, k, v))
+    out = jax.vmap(lambda a, b, c: fa.fused_attention(a, b, c, kv_mask=mask))(
+        qc, kc, vc
+    )
+    ref0 = dense_attention(q, k, v, kv_mask=mask)
+    ref1 = dense_attention(2 * q, 2 * k, 2 * v, kv_mask=mask)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref0), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out[1]), np.asarray(ref1), atol=2e-5)
+
+
+def test_bf16_inputs(qkvm):
+    q, k, v, mask = qkvm
+    out = fa.fused_attention(
+        q.astype(jnp.bfloat16), k.astype(jnp.bfloat16), v.astype(jnp.bfloat16),
+        kv_mask=mask,
+    )
+    assert out.dtype == jnp.bfloat16
+    ref = dense_attention(q, k, v, kv_mask=mask)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=0.05
+    )
+
+
+def test_empty_row_fully_masked():
+    """A row whose keys are ALL masked must produce finite output (the
+    reference semantics: downstream pooling ignores these rows)."""
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(1, 64, 2, 16)), jnp.float32)
+    mask = jnp.zeros((1, 64), bool)
+    out = fa.fused_attention(q, q, q, kv_mask=mask)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+    grads = jax.grad(
+        lambda a: jnp.sum(fa.fused_attention(a, a, a, kv_mask=mask))
+    )(q)
+    assert bool(jnp.all(jnp.isfinite(grads)))
+
+
+def test_attention_fn_integration_matches_default():
+    """``attention_fn`` drop-in inside MultiHeadDotProductAttention: same
+    parameter tree, same output as the default flax path."""
+    import flax.linen as nn
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(2, 48, 32)), jnp.float32)
+    mask = jnp.asarray(rng.random((2, 48)) > 0.2)[:, None, None, :]
+
+    fused_mod = nn.MultiHeadDotProductAttention(
+        num_heads=4, deterministic=True, attention_fn=fa.attention_fn
+    )
+    stock_mod = nn.MultiHeadDotProductAttention(num_heads=4, deterministic=True)
+    params = fused_mod.init(jax.random.PRNGKey(0), x, x, mask=mask)
+    assert jax.tree.structure(params) == jax.tree.structure(
+        stock_mod.init(jax.random.PRNGKey(0), x, x, mask=mask)
+    )
+    out_fused = fused_mod.apply(params, x, x, mask=mask)
+    out_stock = stock_mod.apply(params, x, x, mask=mask)
+    np.testing.assert_allclose(
+        np.asarray(out_fused), np.asarray(out_stock), atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("t", [700, 1280])
+def test_nondivisor_block_heights(t):
+    """t_pad in {768, 1280, ...} once picked a block height that did not
+    divide the padded sequence, silently dropping trailing query rows —
+    every row must now be computed."""
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.normal(size=(1, t, 1, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, t, 1, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, t, 1, 16)), jnp.float32)
+    out = fa.fused_attention(q, k, v)
+    ref = dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_pick_blk_divides():
+    for t_pad in range(128, 8192 + 1, 128):
+        blk = fa._pick_blk(t_pad)
+        assert blk % 128 == 0 and t_pad % blk == 0, (t_pad, blk)
+
+
+def test_attention_fn_cross_attention_falls_back():
+    """T_kv != T_q (decoder-style memory attention) must route to the XLA
+    path, not crash in the kernel wrapper."""
+    import flax.linen as nn
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(1, 64, 16)), jnp.float32)
+    mem = jnp.asarray(rng.normal(size=(1, 48, 16)), jnp.float32)
+    mod = nn.MultiHeadDotProductAttention(
+        num_heads=2, deterministic=True, attention_fn=fa.attention_fn
+    )
+    params = mod.init(jax.random.PRNGKey(0), x, mem)
+    stock = nn.MultiHeadDotProductAttention(num_heads=2, deterministic=True)
+    np.testing.assert_allclose(
+        np.asarray(mod.apply(params, x, mem)),
+        np.asarray(stock.apply(params, x, mem)),
+        atol=2e-5,
+    )
+
+
+def test_eligibility_gates():
+    q4 = jnp.zeros((1, 256, 2, 16))
+    # interpret mode: no MIN_FUSED_T floor (correctness tests use tiny T)
+    assert fa.eligible(q4, None, 0.0, True)
+    # attention-probability dropout active -> XLA fallback
+    assert not fa.eligible(q4, None, 0.1, False)
+    # dropout configured but deterministic -> kernel ok
+    assert fa.eligible(q4, None, 0.1, True)
+    # a q-dependent (non-key-padding) mask -> fallback
+    bad_mask = jnp.ones((1, 1, 256, 256), bool)
+    assert not fa.eligible(q4, bad_mask, 0.0, True)
+    ok_mask = jnp.ones((1, 1, 1, 256), bool)
+    assert fa.eligible(q4, ok_mask, 0.0, True)
+    # a per-head mask -> fallback
+    head_mask = jnp.ones((1, 2, 1, 256), bool)
+    assert not fa.eligible(q4, head_mask, 0.0, True)
+    # cross-attention (different key length) -> fallback
+    assert not fa.eligible(q4, None, 0.0, True, k=jnp.zeros((1, 128, 2, 16)))
+    assert fa.eligible(q4, None, 0.0, True, k=jnp.zeros((1, 256, 2, 16)))
+    # beyond the VMEM bound -> fallback
+    assert not fa.kernel_eligible(fa.MAX_FUSED_T * 2, 64)
+    # wide heads -> fallback
+    assert not fa.kernel_eligible(256, 256)
