@@ -204,6 +204,63 @@ def measure_threaded_baseline() -> float:
     return rounds_per_sec
 
 
+LC_SEQ = 2048
+LC_BATCH = 8
+
+
+def measure_long_context() -> tuple[float, float]:
+    """(fused ms/step, unfused ms/step) for a LongContextTransformer
+    training step at seq LC_SEQ — the fused-attention Pallas kernel vs the
+    same model gated to XLA's attention (BASELINE.md round-3 section)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_learning_simulator_tpu.models.long_context import (
+        LongContextTransformer,
+    )
+    from distributed_learning_simulator_tpu.ops import fused_attention as fa
+
+    model = LongContextTransformer(vocab_size=8192, num_classes=4, max_len=LC_SEQ)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(1, 8192, (LC_BATCH, LC_SEQ)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, 4, (LC_BATCH,)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens[:1])
+
+    def loss_fn(p, tokens, labels):
+        p16 = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            p,
+        )
+        logits = model.apply(p16, tokens)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], 1))
+
+    def measure(disable: bool, n: int = 10) -> float:
+        saved = fa.MIN_FUSED_T
+        fa.MIN_FUSED_T = 10**9 if disable else saved
+        try:
+
+            @jax.jit
+            def train_step(p, tokens, labels):
+                l, g = jax.value_and_grad(loss_fn)(p, tokens, labels)
+                return jax.tree.map(lambda a, b: a - 0.01 * b, p, g), l
+
+            p, l = train_step(params, tokens, labels)
+            float(np.asarray(l))  # hard sync (tunnel: block_until_ready lies)
+            start = time.monotonic()
+            for _ in range(n):
+                p, l = train_step(p, tokens, labels)
+            float(np.asarray(l))
+            return (time.monotonic() - start) / n * 1e3
+        finally:
+            fa.MIN_FUSED_T = saved
+
+    return measure(disable=False), measure(disable=True)
+
+
 def main() -> None:
     value, mfu = measure_spmd()
     try:
@@ -218,6 +275,13 @@ def main() -> None:
         vit_value, vit_mfu = measure_vit()
     except Exception:
         vit_value, vit_mfu = 0.0, 0.0
+    # long-context entry: fused-attention Pallas kernel vs XLA attention on
+    # the same seq-2048 training step (round 3)
+    try:
+        lc_fused_ms, lc_xla_ms = measure_long_context()
+        lc_speedup = lc_xla_ms / lc_fused_ms if lc_fused_ms else 0.0
+    except Exception:
+        lc_fused_ms, lc_xla_ms, lc_speedup = 0.0, 0.0, 0.0
     print(
         json.dumps(
             {
@@ -232,6 +296,13 @@ def main() -> None:
                     "value": round(vit_value, 4),
                     "unit": "rounds/sec",
                     "mfu": round(vit_mfu, 4),
+                    "dtype": "bf16",
+                },
+                "long_context": {
+                    "metric": f"longcontext_seq{LC_SEQ}_train_step_ms",
+                    "fused_ms": round(lc_fused_ms, 2),
+                    "xla_ms": round(lc_xla_ms, 2),
+                    "speedup": round(lc_speedup, 2),
                     "dtype": "bf16",
                 },
             }

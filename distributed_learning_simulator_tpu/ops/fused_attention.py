@@ -13,16 +13,25 @@ materialization saturates HBM — so ``attention_fn`` gates the kernel to
 ``MIN_FUSED_T ≤ T ≤ MAX_FUSED_T`` and the zoo's short-sequence encoders
 (ViT seq 64, IMDB seq 300) keep the XLA path.
 
-Design (deliberately simpler than a streaming/online-softmax kernel): one
-level of blocking.  The grid is ``(batch*heads, q_blocks)``; each step
-loads one [blk, D] query block plus the FULL [T, D] key/value rows for
-that (batch, head) into VMEM and runs an exact softmax over the complete
-key axis — no streaming recurrence needed.  The query block height adapts
-to the sequence (``_pick_blk``: fat blocks at short T for fewer grid
-steps, 128-row blocks at the long end).  Full K/V rows in VMEM bound the
-fusable sequence (``MAX_FUSED_T``); beyond that the sequence-parallel
-path (``parallel/ring_attention.py``) shards T over the mesh and each
-device's local block lands back inside this bound.
+Two kernel tiers (``kernel_tier`` picks per shape/dtype):
+
+* **one-level** (``"fused"``): the grid is ``(batch*heads, q_blocks)``;
+  each step loads one [blk, D] query block plus the FULL [T, D] key/value
+  rows for that (batch, head) into VMEM and runs an exact softmax over
+  the complete key axis — no streaming recurrence.  The query block
+  height adapts to the sequence (``_pick_blk``: fat blocks at short T for
+  fewer grid steps, 128-row blocks at the long end).  Fastest tier, but
+  full K/V rows in VMEM bound it (``MAX_FUSED_T``, dtype-aware model).
+* **streaming** (``"stream"``): the classic online-softmax walk — grid
+  ``(batch*heads, q_blocks, kv_blocks)`` with running (acc, m, l) VMEM
+  scratch, so VMEM is O(blk²) regardless of T.  Extends the single-chip
+  fusable sequence to ``MAX_STREAM_T`` (measured on the v5e: seq 16384
+  trains end-to-end at 165 ms/step, seq 32768 fwd+bwd 163 ms raw, where
+  both XLA attention and the one-level tier OOM).
+
+Beyond ``MAX_STREAM_T`` the sequence-parallel path
+(``parallel/ring_attention.py``) shards T over the mesh and each
+device's local block lands back inside these bounds.
 
 The backward pass is two Pallas kernels (recompute-style, the standard
 flash-attention adjoint): ``dq`` re-forms each query block's probabilities
@@ -35,7 +44,7 @@ Integration: ``attention_fn`` is a drop-in for
 parameter tree, kwargs filtered by signature.  It falls back to flax's
 ``dot_product_attention`` whenever the kernel doesn't apply (attention-
 probability dropout active, a mask that isn't a pure key-padding mask,
-head_dim > 128, T > MAX_FUSED_T, or a non-TPU backend — the interpreter
+head_dim > 128, T > MAX_STREAM_T, or a non-TPU backend — the interpreter
 is far too slow for the CPU test mesh, where the XLA path is used
 instead; set ``DLS_TPU_FUSED_ATTN=interpret`` to force the kernel under
 the Pallas interpreter for kernel tests).
@@ -53,20 +62,27 @@ from jax.experimental.pallas import tpu as pltpu
 LANE = 128
 MIN_FUSED_T = 1024  # below this XLA's batched-matmul attention is faster
 MAX_FUSED_T = 8192  # full K/V rows per (batch, head) must fit VMEM
+MAX_STREAM_T = 32768  # streaming tier: K/V walked block-by-block from HBM
 _S_VMEM_BYTES = 2 * 1024 * 1024  # budget for one [blk, T] f32 score block
+_STREAM_BLK = 512  # q/kv block edge for the streaming tier
 _NEG_INF = -1e30
 
 
-def _pick_blk(t_pad: int) -> int:
-    """Largest 128-multiple row block that DIVIDES ``t_pad`` (the grid is
-    ``t_pad // blk`` steps — a non-divisor would silently drop trailing
-    query rows) and whose [blk, T] f32 score tile fits the VMEM budget —
-    fewer, fatter grid steps at short T; 128-row steps at the long end."""
-    cap = max(128, (_S_VMEM_BYTES // (t_pad * 4)) // 128 * 128)
-    blk = min(t_pad, cap)
+def _divisor_blk(t_pad: int, cap: int) -> int:
+    """Largest 128-multiple row block ≤ cap that DIVIDES ``t_pad`` (the
+    grid is ``t_pad // blk`` steps — a non-divisor would silently drop
+    trailing rows)."""
+    blk = min(t_pad, max(128, cap))
     while t_pad % blk:
         blk -= 128
     return blk
+
+
+def _pick_blk(t_pad: int) -> int:
+    """One-level tier: fattest block whose [blk, T] f32 score tile fits the
+    VMEM budget — fewer grid steps at short T; 128-row steps at the long
+    end."""
+    return _divisor_blk(t_pad, (_S_VMEM_BYTES // (t_pad * 4)) // 128 * 128)
 
 
 def _mode() -> str:
@@ -83,21 +99,41 @@ def _interp(interpret: bool):
 
 
 # ----------------------------------------------------------------- forward
+def _masked_scores(
+    rows, cols, kmask_row, scale, causal, row_off, col_off, keys_on_rows
+):
+    """Scores + validity for one tile — THE single definition of the
+    masking semantics shared by all six kernels (forward/dq/dkv in both
+    tiers).  ``rows @ cols^T * scale``; ``kmask_row`` is the [1, N_keys]
+    f32 key-padding row for the tile's KEY side (compared against 0.0
+    AFTER any reshape — Mosaic only supports minor-dim-inserting reshapes
+    for 32-bit types, not i1); causal masking reconstructs global
+    positions from the tile offsets, with q/k roles swapped when the tile
+    is key-major (``keys_on_rows``)."""
+    s = jax.lax.dot_general(
+        rows, cols, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if keys_on_rows:
+        valid = kmask_row.reshape(-1, 1) != 0.0  # [BK, 1] over rows
+    else:
+        valid = kmask_row != 0.0  # [1, BK] broadcasts over rows
+    if causal:
+        r_pos = row_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        c_pos = col_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        q_pos, k_pos = (c_pos, r_pos) if keys_on_rows else (r_pos, c_pos)
+        valid = valid & (q_pos >= k_pos)
+    return s, valid
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, scale, causal):
     blk = q_ref.shape[1]
     q = q_ref[0]  # [blk, D]
     k = k_ref[0]  # [T, D]
     v = v_ref[0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # [BLK, T]
-    valid = (mask_ref[0] != 0.0)  # [1, T] -> broadcasts over rows
-    if causal:
-        q_pos = pl.program_id(1) * blk + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 0
-        )
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        valid = valid & (q_pos >= k_pos)
+    s, valid = _masked_scores(
+        q, k, mask_ref[0], scale, causal, pl.program_id(1) * blk, 0, False
+    )  # [blk, T]
     s = jnp.where(valid, s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)  # [blk, 1]
     p = jnp.where(valid, jnp.exp(s - m), 0.0)
@@ -147,16 +183,9 @@ def _dq_kernel(
     k = k_ref[0]
     v = v_ref[0]
     do = do_ref[0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # [blk, T]
-    valid = (mask_ref[0] != 0.0)
-    if causal:
-        q_pos = pl.program_id(1) * blk + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 0
-        )
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        valid = valid & (q_pos >= k_pos)
+    s, valid = _masked_scores(
+        q, k, mask_ref[0], scale, causal, pl.program_id(1) * blk, 0, False
+    )  # [blk, T]
     lse = lse_ref[0].reshape(-1, 1)  # [blk, 1]
     p = jnp.where(valid, jnp.exp(s - lse), 0.0)
     dp = jax.lax.dot_general(
@@ -181,17 +210,10 @@ def _dkv_kernel(
     k = k_ref[0]  # [blk, D] one key block
     v = v_ref[0]
     do = do_ref[0]  # [T, D]
-    s_t = jax.lax.dot_general(
-        k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # [blk, T] = scores transposed (keys x queries)
-    # kmask_ref is blocked per KEY block: [1, BLK] validity of these keys
-    # (reshape the f32 mask, not the i1 compare result — Mosaic only
-    # supports minor-dim-inserting reshapes for 32-bit types)
-    valid = kmask_ref[0].reshape(-1, 1) != 0.0
-    if causal:
-        k_pos = j * blk + jax.lax.broadcasted_iota(jnp.int32, s_t.shape, 0)
-        q_pos = jax.lax.broadcasted_iota(jnp.int32, s_t.shape, 1)
-        valid = valid & (q_pos >= k_pos)
+    # kmask_ref is blocked per KEY block: [1, blk] validity of these keys
+    s_t, valid = _masked_scores(
+        k, q, kmask_ref[0], scale, causal, j * blk, 0, True
+    )  # [blk, T] = scores transposed (keys x queries)
     lse = lse_ref[0]  # [1, T] per-query normalizers
     p_t = jnp.where(valid, jnp.exp(s_t - lse), 0.0)  # [blk, T]
     dv = jax.lax.dot_general(
@@ -252,21 +274,239 @@ def _bwd(q3, k3, v3, mask2, out3, lse, do3, heads, scale, causal, interpret):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _attend(q3, k3, v3, mask2, heads, scale, causal, interpret):
-    out, _ = _fwd(q3, k3, v3, mask2, heads, scale, causal, interpret)
+# ------------------------------------------------- streaming tier (long T)
+# Beyond the one-level tier's VMEM bound the kernels walk K/V block-by-block
+# from HBM with the online-softmax recurrence — VMEM is O(blk^2) regardless
+# of T, extending the single-chip fusable sequence to MAX_STREAM_T.
+
+
+def _fwd_stream_kernel(
+    q_ref, k_ref, v_ref, kmask_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, scale, causal, nk,
+):
+    kidx = pl.program_id(2)
+
+    @pl.when(kidx == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0]  # [BQ, D]
+    k = k_ref[0]  # [BK, D]
+    v = v_ref[0]
+    s, valid = _masked_scores(
+        q, k, kmask_ref[0], scale, causal,
+        pl.program_id(1) * q.shape[0], kidx * k.shape[0], False,
+    )  # [BQ, BK]
+    s = jnp.where(valid, s, _NEG_INF)
+    # m/l scratch is [BQ, 128] with every lane holding the row value (the
+    # 128-lane layout Mosaic wants for narrow per-row state)
+    m_old = m_ref[:, :1]  # [BQ, 1]
+    m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+    alpha = jnp.exp(m_old - m_new)
+    l_ref[...] = l_ref[...] * alpha + jnp.broadcast_to(
+        jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+    )
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(kidx == nk - 1)
+    def _():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, :1] + jnp.log(l)).reshape(1, -1)
+
+
+def _dq_stream_kernel(
+    q_ref, k_ref, v_ref, kmask_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    dq_acc_ref, *, scale, causal, nk,
+):
+    kidx = pl.program_id(2)
+
+    @pl.when(kidx == 0)
+    def _():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    s, valid = _masked_scores(
+        q, k, kmask_ref[0], scale, causal,
+        pl.program_id(1) * q.shape[0], kidx * k.shape[0], False,
+    )  # [BQ, BK]
+    lse = lse_ref[0].reshape(-1, 1)
+    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    delta = delta_ref[0].reshape(-1, 1)
+    ds = p * (dp - delta)
+    dq_acc_ref[...] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    @pl.when(kidx == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _dkv_stream_kernel(
+    q_ref, k_ref, v_ref, kmask_ref, do_ref, lse_ref, delta_ref, dk_ref,
+    dv_ref, dk_acc_ref, dv_acc_ref, *, scale, causal, nq,
+):
+    qidx = pl.program_id(2)
+
+    @pl.when(qidx == 0)
+    def _():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    q = q_ref[0]  # [BQ, D]
+    k = k_ref[0]  # [BK, D]
+    v = v_ref[0]
+    do = do_ref[0]  # [BQ, D]
+    s_t, valid = _masked_scores(
+        k, q, kmask_ref[0], scale, causal,
+        pl.program_id(1) * k.shape[0], qidx * q.shape[0], True,
+    )  # [BK, BQ]
+    lse = lse_ref[0]  # [1, BQ]
+    p_t = jnp.where(valid, jnp.exp(s_t - lse), 0.0)  # [BK, BQ]
+    dv_acc_ref[...] += jax.lax.dot_general(
+        p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp_t = jax.lax.dot_general(
+        v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [BK, BQ]
+    delta = delta_ref[0]  # [1, BQ]
+    ds_t = p_t * (dp_t - delta)
+    dk_acc_ref[...] += jax.lax.dot_general(
+        ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    @pl.when(qidx == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _fwd_stream(q3, k3, v3, mask2, heads, scale, causal, interpret):
+    bh, t, d = q3.shape
+    blk = _divisor_blk(t, _STREAM_BLK)
+    nq, nk = t // blk, t // blk
+    q_spec = pl.BlockSpec((1, blk, d), lambda b, i, j: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, blk, d), lambda b, i, j: (b, j, 0))
+    kmask_spec = pl.BlockSpec((1, 1, blk), lambda b, i, j: (b // heads, 0, j))
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_stream_kernel, scale=scale, causal=causal, nk=nk
+        ),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, kmask_spec],
+        out_specs=(
+            q_spec,
+            pl.BlockSpec((1, 1, blk), lambda b, i, j: (b, 0, i)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((blk, d), jnp.float32),
+            pltpu.VMEM((blk, LANE), jnp.float32),
+            pltpu.VMEM((blk, LANE), jnp.float32),
+        ],
+        interpret=_interp(interpret),
+    )(q3, k3, v3, mask2)
+    return out, lse
+
+
+def _bwd_stream(q3, k3, v3, mask2, out3, lse, do3, heads, scale, causal,
+                interpret):
+    bh, t, d = q3.shape
+    delta = jnp.sum(
+        do3.astype(jnp.float32) * out3.astype(jnp.float32), axis=-1
+    )[:, None, :]
+    blk = _divisor_blk(t, _STREAM_BLK)
+    nq, nk = t // blk, t // blk
+    q_spec = pl.BlockSpec((1, blk, d), lambda b, i, j: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, blk, d), lambda b, i, j: (b, j, 0))
+    kmask_spec = pl.BlockSpec((1, 1, blk), lambda b, i, j: (b // heads, 0, j))
+    row_q_spec = pl.BlockSpec((1, 1, blk), lambda b, i, j: (b, 0, i))
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_stream_kernel, scale=scale, causal=causal, nk=nk
+        ),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, kmask_spec, q_spec,
+                  row_q_spec, row_q_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        scratch_shapes=[pltpu.VMEM((blk, d), jnp.float32)],
+        interpret=_interp(interpret),
+    )(q3, k3, v3, mask2, do3, lse, delta)
+    # dkv walks the QUERY axis innermost; k/v blocks are pinned per middle
+    # grid index
+    kv_pin_spec = pl.BlockSpec((1, blk, d), lambda b, j, i: (b, j, 0))
+    q_walk_spec = pl.BlockSpec((1, blk, d), lambda b, j, i: (b, i, 0))
+    kmask_pin_spec = pl.BlockSpec(
+        (1, 1, blk), lambda b, j, i: (b // heads, 0, j)
+    )
+    row_walk_spec = pl.BlockSpec((1, 1, blk), lambda b, j, i: (b, 0, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_stream_kernel, scale=scale, causal=causal, nq=nq
+        ),
+        grid=(bh, nk, nq),
+        in_specs=[q_walk_spec, kv_pin_spec, kv_pin_spec, kmask_pin_spec,
+                  q_walk_spec, row_walk_spec, row_walk_spec],
+        out_specs=(kv_pin_spec, kv_pin_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct(k3.shape, k3.dtype),
+            jax.ShapeDtypeStruct(v3.shape, v3.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((blk, d), jnp.float32),
+            pltpu.VMEM((blk, d), jnp.float32),
+        ],
+        interpret=_interp(interpret),
+    )(q3, k3, v3, mask2, do3, lse, delta)
+    return dq, dk, dv
+
+
+def _fwd_tier(tier, *args):
+    return (_fwd if tier == "fused" else _fwd_stream)(*args)
+
+
+def _bwd_tier(tier, *args):
+    return (_bwd if tier == "fused" else _bwd_stream)(*args)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _attend(q3, k3, v3, mask2, heads, scale, causal, interpret, tier):
+    out, _ = _fwd_tier(tier, q3, k3, v3, mask2, heads, scale, causal, interpret)
     return out
 
 
-def _attend_fwd(q3, k3, v3, mask2, heads, scale, causal, interpret):
-    out, lse = _fwd(q3, k3, v3, mask2, heads, scale, causal, interpret)
+def _attend_fwd(q3, k3, v3, mask2, heads, scale, causal, interpret, tier):
+    out, lse = _fwd_tier(
+        tier, q3, k3, v3, mask2, heads, scale, causal, interpret
+    )
     return out, (q3, k3, v3, mask2, out, lse)
 
 
-def _attend_bwd(heads, scale, causal, interpret, res, do3):
+def _attend_bwd(heads, scale, causal, interpret, tier, res, do3):
     q3, k3, v3, mask2, out, lse = res
-    dq, dk, dv = _bwd(
-        q3, k3, v3, mask2, out, lse, do3, heads, scale, causal, interpret
+    dq, dk, dv = _bwd_tier(
+        tier, q3, k3, v3, mask2, out, lse, do3, heads, scale, causal, interpret
     )
     return dq, dk, dv, None
 
@@ -274,13 +514,17 @@ def _attend_bwd(heads, scale, causal, interpret, res, do3):
 _attend.defvjp(_attend_fwd, _attend_bwd)
 
 
-def fused_attention(q, k, v, kv_mask=None, causal: bool = False):
+def fused_attention(q, k, v, kv_mask=None, causal: bool = False, tier=None):
     """Exact fused attention.  ``q/k/v: [B, T, H, D]`` (flax head layout),
     ``kv_mask: [B, T]`` key-padding mask (True = attend) or None.  The
-    caller is responsible for eligibility (see :func:`kernel_eligible`);
-    callers wanting automatic gating + fallback use :func:`attention_fn`."""
+    caller is responsible for eligibility (see :func:`kernel_tier`);
+    callers wanting automatic gating + fallback use :func:`attention_fn`.
+    ``tier`` overrides the automatic one-level/streaming choice (tests)."""
     mode = _mode()
     b, t, h, d = q.shape
+    if tier is None:
+        tier = kernel_tier(t, d, q.dtype.itemsize, _perf_gate=False)
+    assert tier in ("fused", "stream"), f"ineligible shape T={t} D={d}"
     scale = 1.0 / math.sqrt(d)
     t_pad = max(128, ((t + 127) // 128) * 128)
     # K/V loads and dq/dk/dv writes pay for padded D bytes: pad only to the
@@ -296,7 +540,9 @@ def fused_attention(q, k, v, kv_mask=None, causal: bool = False):
         jnp.float32
     )
     mask2 = jnp.pad(mask, ((0, 0), (0, t_pad - t)))[:, None, :]
-    out = _attend(q3, k3, v3, mask2, h, scale, causal, mode == "interpret")
+    out = _attend(
+        q3, k3, v3, mask2, h, scale, causal, mode == "interpret", tier
+    )
     out = out[:, :t, :d].reshape(b, h, t, d)
     return jnp.transpose(out, (0, 2, 1, 3))
 
@@ -304,31 +550,41 @@ def fused_attention(q, k, v, kv_mask=None, causal: bool = False):
 _VMEM_BUDGET = 15 * 1024 * 1024  # leave headroom under the 16 MB scoped limit
 
 
-def kernel_eligible(t: int, d: int, itemsize: int = 2) -> bool:
-    """Shape/backend eligibility for the kernel itself.  The MIN_FUSED_T
-    gate is a measured perf crossover (BASELINE.md: below ~1024 XLA's
-    batched-matmul attention wins on step-overhead; at/above it the fused
-    kernel is at parity and pulls ahead with T) and applies only to the
-    compiled TPU path — the interpreter mode exists for correctness tests
-    at small shapes.  The VMEM model mirrors what Mosaic stack-allocates
-    per grid step (measured on the v5e): full K/V rows plus ~4 [blk, T]
-    f32 score-sized temporaries — f32 inputs at seq 8k exceed the 16 MB
-    scoped limit where bf16 fits, so eligibility is dtype-aware.  The
-    coefficients are anchored on measured compiles: bf16 T=8192 d=64
-    fits (14.7 MB est.), f32 T=8192 OOMs (16.8 MB est. vs the observed
-    16.5 MB allocation), bf16 T=16384 d_pad=128 OOMs."""
+def kernel_tier(
+    t: int, d: int, itemsize: int = 2, _perf_gate: bool = True
+) -> str | None:
+    """Which kernel tier serves shape (T, D): ``"fused"`` (one-level, full
+    K/V rows in VMEM), ``"stream"`` (online-softmax walk over K/V blocks,
+    VMEM O(blk^2) — up to MAX_STREAM_T), or None (XLA fallback).
+
+    The MIN_FUSED_T floor is a measured perf crossover (BASELINE.md: below
+    ~1024 XLA's batched-matmul attention wins on step-overhead) and applies
+    only to the compiled TPU path — the interpreter mode exists for
+    correctness tests at small shapes.  The one-level VMEM model mirrors
+    what Mosaic stack-allocates per grid step: full K/V rows plus ~3
+    [blk, T] f32 score-sized temporaries, anchored on measured compiles
+    (bf16 T=8192 d=64 fits at 14.7 MB est.; f32 T=8192 OOMs at 16.8 MB
+    est. vs the observed 16.5 MB allocation; bf16 T=16384 d_pad=128 OOMs).
+    Shapes past the one-level bound take the streaming tier instead."""
     mode = _mode()
-    if mode == "off":
-        return False
-    if d > LANE or t > MAX_FUSED_T:
-        return False
-    if mode == "tpu" and t < MIN_FUSED_T:
-        return False
+    if mode == "off" or d > LANE:
+        return None
+    if _perf_gate and mode == "tpu" and t < MIN_FUSED_T:
+        return None
     t_pad = max(128, ((t + 127) // 128) * 128)
     d_pad = 64 if d <= 64 else LANE
     kv_bytes = 2 * t_pad * d_pad * itemsize
     temp_bytes = 3 * _pick_blk(t_pad) * t_pad * 4
-    return kv_bytes + temp_bytes <= _VMEM_BUDGET
+    if t <= MAX_FUSED_T and kv_bytes + temp_bytes <= _VMEM_BUDGET:
+        return "fused"
+    if t <= MAX_STREAM_T:
+        return "stream"
+    return None
+
+
+def kernel_eligible(t: int, d: int, itemsize: int = 2) -> bool:
+    """True when any kernel tier serves this shape on this backend."""
+    return kernel_tier(t, d, itemsize) is not None
 
 
 def eligible(q, mask, dropout_rate: float, deterministic: bool, k=None) -> bool:

@@ -148,33 +148,91 @@ class SpmdShapleySession(SpmdFedAvgSession):
         config = self.config
         save_dir = os.path.join(config.save_dir, "server")
         os.makedirs(save_dir, exist_ok=True)
-        global_params = put_sharded(
-            self.engine.init_params(config.seed), self._replicated
-        )
-        # need_init_performance: round-0 metric seeds the SV engine
-        # (reference ``shapley_value_server.py:4-7``)
-        init_metric = self._evaluate(global_params)
-        self._stat[0] = {f"test_{k}": v for k, v in init_metric.items()}
+        # resume from a previous session's latest round checkpoint (same
+        # discovery as fed_avg/GNN/OBD: util/resume.py), else fresh init
+        global_params, start_round = self._init_global_params()
+        if start_round == 1:
+            # need_init_performance: round-0 metric seeds the SV engine
+            # (reference ``shapley_value_server.py:4-7``)
+            init_metric = self._evaluate(global_params)
+            self._stat[0] = {f"test_{k}": v for k, v in init_metric.items()}
+        else:
+            self._restore_sv_records(start_round)
         rng = jax.random.PRNGKey(config.seed)
+        for _ in range(start_round - 1):  # resume: keep the rng stream aligned
+            rng, _unused = jax.random.split(rng)
         choose_best = bool(config.algorithm_kwargs.get("choose_best_subset", False))
 
         with self._ckpt:  # flush async round checkpoints at exit
-            self._run_rounds(config, global_params, rng, choose_best, save_dir)
+            self._run_rounds(
+                config, global_params, rng, choose_best, save_dir, start_round
+            )
 
-        with open(
-            os.path.join(config.save_dir, "shapley_values.json"),
-            "wt",
-            encoding="utf8",
-        ) as f:
-            json.dump({str(k): v for k, v in self.shapley_values.items()}, f)
+        self._dump_sv()
         return {
             "performance": {k: v for k, v in self._stat.items() if k > 0},
             "sv": self.shapley_values,
             "sv_S": self.shapley_values_S,
         }
 
-    def _run_rounds(self, config, global_params, rng, choose_best, save_dir):
-        for round_number in range(1, config.round + 1):
+    def _restore_sv_records(self, start_round: int) -> None:
+        """Bring forward the previous session's per-round SV dicts (dumped
+        incrementally, so they survive a crash); a tail from rounds at or
+        beyond the resume point is superseded and dropped.  The rebuilt
+        engine is seeded with the last recorded round accuracy (its
+        ``last_round_metric`` carry — with ``choose_best_subset`` the
+        recorded metric is the chosen subset's, a documented deviation
+        matching the threaded server's resume)."""
+        resume_dir = self.config.algorithm_kwargs.get("resume_dir")
+        for name, target in (
+            ("shapley_values.json", self.shapley_values),
+            ("shapley_values_S.json", self.shapley_values_S),
+        ):
+            path = os.path.join(resume_dir, name)
+            if os.path.isfile(path):
+                try:
+                    with open(path, encoding="utf8") as f:
+                        target.update(
+                            {int(k): v for k, v in json.load(f).items()}
+                        )
+                except (json.JSONDecodeError, ValueError):
+                    # a crash mid-write can only leave a stale-but-valid
+                    # file (writes go through os.replace), but tolerate a
+                    # corrupt one from any source: params/round still
+                    # resume, only that SV history is lost
+                    get_logger().warning(
+                        "unreadable %s; resuming without its SV history",
+                        path,
+                    )
+        for d in (self.shapley_values, self.shapley_values_S):
+            for k in [k for k in d if k >= start_round]:
+                del d[k]
+        get_logger().info(
+            "resumed shapley session at round %d (%d SV rounds restored)",
+            start_round,
+            len(self.shapley_values),
+        )
+
+    def _dump_sv(self) -> None:
+        """Both SV artifacts, rewritten after every round — same names as
+        the threaded server (``method/shapley_value``).  Written to a temp
+        file then ``os.replace``d so a crash mid-write (the exact window
+        the per-round rewrite exists to survive) can never leave a
+        truncated file for resume to choke on."""
+        for name, source in (
+            ("shapley_values.json", self.shapley_values),
+            ("shapley_values_S.json", self.shapley_values_S),
+        ):
+            path = os.path.join(self.config.save_dir, name)
+            tmp = path + ".tmp"
+            with open(tmp, "wt", encoding="utf8") as f:
+                json.dump({str(k): v for k, v in source.items()}, f)
+            os.replace(tmp, path)
+
+    def _run_rounds(
+        self, config, global_params, rng, choose_best, save_dir, start_round=1
+    ):
+        for round_number in range(start_round, config.round + 1):
             weights = put_sharded(
                 self._select_weights(round_number), self._client_sharding
             )
@@ -190,9 +248,14 @@ class SpmdShapleySession(SpmdFedAvgSession):
 
             workers, metric_many = self._batch_metric(params_s, weights)
             if self._sv_engine is None:
+                # fresh start: the round-0 init metric; resume: the last
+                # recorded round's accuracy (the engine's running
+                # ``last_round_metric`` carry)
                 self._sv_engine = self._engine_cls(
                     players=workers,
-                    last_round_metric=self._stat[0]["test_accuracy"],
+                    last_round_metric=self._stat[max(self._stat)][
+                        "test_accuracy"
+                    ],
                     **self._engine_kwargs(),
                 )
             # each subset-batch evaluation gets its own deadline — the SV
@@ -214,6 +277,7 @@ class SpmdShapleySession(SpmdFedAvgSession):
             self.shapley_values_S[round_number] = dict(
                 self._sv_engine.shapley_values_S[round_number]
             )
+            self._dump_sv()  # incremental: survives a crash, feeds resume
 
             agg_mask = np.zeros(self.n_slots, np.float32)
             if choose_best and self.shapley_values_S[round_number]:

@@ -106,7 +106,26 @@ class SpmdFedDropoutAvgSession(SpmdFedAvgSession):
 
 class SpmdSMAFDSession(SpmdFedAvgSession):
     """single_model_afd: error-feedback sparsified delta uploads with the
-    residual state living on device across rounds."""
+    residual state living on device across rounds.
+
+    Resume note (documented deviation, matching the threaded executor):
+    ``resume_dir`` restores the global params and round number, but the
+    per-client error-feedback residual restarts at zero — it is in-memory
+    state on both executors (the threaded ``ErrorFeedbackWorker`` keeps it
+    in the worker object) and is not checkpointed (it is worker_number ×
+    model-size, ~100x the round checkpoint at the canonical scale).  A
+    warning is logged so the restart is never silent."""
+
+    def _init_global_params(self):
+        params, start_round = super()._init_global_params()
+        if start_round > 1:
+            from ..utils.logging import get_logger
+
+            get_logger().warning(
+                "smafd resume: error-feedback residuals restart at zero "
+                "(not checkpointed; matches the threaded executor)"
+            )
+        return params, start_round
 
     def _upload_cost_factor(self) -> float:
         kwargs = self.config.algorithm_kwargs

@@ -37,30 +37,62 @@ def qkvm():
     return q, k, v, mask
 
 
+@pytest.mark.parametrize("tier", ["fused", "stream"])
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("with_mask", [False, True])
-def test_forward_matches_dense(qkvm, causal, with_mask):
+def test_forward_matches_dense(qkvm, causal, with_mask, tier):
     q, k, v, mask = qkvm
     m = mask if with_mask else None
-    out = fa.fused_attention(q, k, v, kv_mask=m, causal=causal)
+    out = fa.fused_attention(q, k, v, kv_mask=m, causal=causal, tier=tier)
     ref = dense_attention(q, k, v, causal=causal, kv_mask=m)
     assert out.shape == q.shape
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-def test_gradients_match_dense(qkvm):
+@pytest.mark.parametrize("tier", ["fused", "stream"])
+def test_gradients_match_dense(qkvm, tier):
     q, k, v, mask = qkvm
 
     def loss(attn):
         def f(q, k, v):
-            return jnp.sum(jnp.sin(attn(q, k, v, kv_mask=mask)))
+            return jnp.sum(jnp.sin(attn(q, k, v)))
 
         return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
 
-    got = loss(fa.fused_attention)
-    want = loss(lambda q, k, v, kv_mask: dense_attention(q, k, v, kv_mask=kv_mask))
+    got = loss(lambda q, k, v: fa.fused_attention(q, k, v, kv_mask=mask, tier=tier))
+    want = loss(lambda q, k, v: dense_attention(q, k, v, kv_mask=mask))
     for g, w in zip(got, want):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=2e-5)
+
+
+def test_stream_tier_multi_block_gradients(monkeypatch):
+    """Streaming tier with several kv blocks per query row (the block cap
+    is pinned to 128 so T=384 walks nq=nk=3 blocks — at the default
+    512-row cap this shape would degenerate to a single block and never
+    exercise the online recurrence) — the cross-block alpha rescale,
+    acc/m/l carry, and both accumulating backward walks must agree with
+    dense."""
+    monkeypatch.setattr(fa, "_STREAM_BLK", 128)
+    rng = np.random.default_rng(11)
+    B, T, H, D = 1, 384, 2, 16
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    mask = jnp.asarray(rng.random((B, T)) > 0.3)
+
+    def loss(attn):
+        def f(q, k, v):
+            return jnp.sum(jnp.cos(attn(q, k, v)))
+
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    got = loss(lambda q, k, v: fa.fused_attention(q, k, v, kv_mask=mask, tier="stream"))
+    want = loss(lambda q, k, v: dense_attention(q, k, v, kv_mask=mask))
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=2e-5)
+    out = fa.fused_attention(q, k, v, kv_mask=mask, causal=True, tier="stream")
+    ref = dense_attention(q, k, v, kv_mask=mask, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
 def test_vmap_over_clients(qkvm):
@@ -188,7 +220,11 @@ def test_eligibility_gates():
     # cross-attention (different key length) -> fallback
     assert not fa.eligible(q4, None, 0.0, True, k=jnp.zeros((1, 128, 2, 16)))
     assert fa.eligible(q4, None, 0.0, True, k=jnp.zeros((1, 256, 2, 16)))
-    # beyond the VMEM bound -> fallback
-    assert not fa.kernel_eligible(fa.MAX_FUSED_T * 2, 64)
+    # beyond the one-level VMEM bound -> the streaming tier takes over
+    assert fa.kernel_tier(fa.MAX_FUSED_T * 2, 64) == "stream"
+    # f32 at seq 8k exceeds the one-level VMEM model -> streaming tier
+    assert fa.kernel_tier(8192, 64, itemsize=4) == "stream"
+    # beyond the streaming bound -> fallback (ring/sequence-parallel land)
+    assert not fa.kernel_eligible(fa.MAX_STREAM_T * 2, 64)
     # wide heads -> fallback
     assert not fa.kernel_eligible(256, 256)

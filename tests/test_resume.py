@@ -321,3 +321,60 @@ def test_spmd_resume_matches_uninterrupted_run(tmp_session_dir):
         b = result_resumed["performance"][round_number]
         assert a["test_accuracy"] == b["test_accuracy"], round_number
         assert a["test_loss"] == b["test_loss"], round_number
+
+
+def test_spmd_shapley_resume(tmp_session_dir):
+    """SpmdShapleySession resumes: params from the latest round checkpoint,
+    SV dicts from the incrementally-dumped shapley_values(_S).json, record
+    rows continuous, and the rebuilt engine seeded from the last recorded
+    accuracy (round 3 extension: resume beyond fed_avg/GNN/FedOBD)."""
+    import json
+
+    from distributed_learning_simulator_tpu.config import (
+        DistributedTrainingConfig,
+    )
+
+    def sv_config(**overrides):
+        config = DistributedTrainingConfig(
+            dataset_name="MNIST",
+            model_name="LeNet5",
+            distributed_algorithm="GTG_shapley_value",
+            executor="spmd",
+            worker_number=4,
+            batch_size=16,
+            round=2,
+            epoch=1,
+            learning_rate=0.05,
+            dataset_kwargs={"train_size": 128, "val_size": 32, "test_size": 64},
+        )
+        for key, value in overrides.items():
+            setattr(config, key, value)
+        return config
+
+    first = sv_config()
+    first.load_config_and_process()
+    result1 = train(first)
+    assert set(result1["sv"]) == {1, 2}
+    # incremental dumps exist mid-session artifacts (crash-safe + resume feed)
+    with open(os.path.join(first.save_dir, "shapley_values.json")) as f:
+        assert set(json.load(f)) == {"1", "2"}
+    assert os.path.isfile(
+        os.path.join(first.save_dir, "shapley_values_S.json")
+    )
+
+    resumed = sv_config(
+        round=4, algorithm_kwargs={"resume_dir": first.save_dir}
+    )
+    resumed.load_config_and_process()
+    result2 = train(resumed)
+    # rounds 1-2 SVs brought forward verbatim, 3-4 computed fresh
+    assert set(result2["sv"]) == {1, 2, 3, 4}
+    assert result2["sv"][1] == result1["sv"][1]
+    assert result2["sv"][2] == result1["sv"][2]
+    assert set(result2["performance"]) == {1, 2, 3, 4}
+    assert (
+        result2["performance"][1]["test_accuracy"]
+        == result1["performance"][1]["test_accuracy"]
+    )
+    with open(os.path.join(resumed.save_dir, "shapley_values.json")) as f:
+        assert set(json.load(f)) == {"1", "2", "3", "4"}
