@@ -47,7 +47,16 @@ probability dropout active, a mask that isn't a pure key-padding mask,
 head_dim > 128, T > MAX_STREAM_T, or a non-TPU backend — the interpreter
 is far too slow for the CPU test mesh, where the XLA path is used
 instead; set ``DLS_TPU_FUSED_ATTN=interpret`` to force the kernel under
-the Pallas interpreter for kernel tests).
+the Pallas interpreter for kernel tests, or ``=off`` to kill the kernel
+everywhere).
+
+Sharded-context note: inside ``shard_map`` (the ring/Ulysses path) the
+kernels see per-device blocks and compose cleanly.  Inside a
+GSPMD-partitioned ``jit`` (``model_parallel`` TP), XLA treats a Pallas
+call as opaque and will all-gather sharded operands to run it replicated
+— correct but unprofitable, and the *interpreter* variant (an
+``io_callback``) cannot be partitioned at all; prefer shard_map contexts
+for sharded attention, or ``DLS_TPU_FUSED_ATTN=off`` under TP.
 """
 
 import functools
@@ -86,10 +95,17 @@ def _pick_blk(t_pad: int) -> int:
 
 
 def _mode() -> str:
-    """'tpu' (compiled), 'interpret' (forced for kernel tests), or 'off'."""
+    """'tpu' (compiled), 'interpret' (forced for kernel tests), or 'off'.
+
+    ``DLS_TPU_FUSED_ATTN=off`` is the operator kill switch — every caller
+    gates through :func:`kernel_tier`, so setting it routes ALL attention
+    back to the XLA paths (flax / dense / jnp ring)."""
+    env = os.environ.get("DLS_TPU_FUSED_ATTN", "")
+    if env == "off":
+        return "off"
     if jax.default_backend() == "tpu":
         return "tpu"
-    if os.environ.get("DLS_TPU_FUSED_ATTN") == "interpret":
+    if env == "interpret":
         return "interpret"
     return "off"
 
@@ -233,11 +249,16 @@ def _dkv_kernel(
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(q3, k3, v3, mask2, out3, lse, do3, heads, scale, causal, interpret):
+def _bwd(q3, k3, v3, mask2, out3, lse, do3, heads, scale, causal, interpret,
+         dlse=None):
     bh, t, d = q3.shape
     delta = jnp.sum(
         do3.astype(jnp.float32) * out3.astype(jnp.float32), axis=-1
     )[:, None, :]
+    if dlse is not None:
+        # lse-output cotangent: d lse_i/d s_ij = p_ij, so it folds into the
+        # SAME ds = p*(dp - delta') recurrence with delta' = delta - dlse
+        delta = delta - dlse
     blk = _pick_blk(t)
     q_spec = pl.BlockSpec((1, blk, d), lambda b, i: (b, i, 0))
     full_spec = pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0))
@@ -430,11 +451,13 @@ def _fwd_stream(q3, k3, v3, mask2, heads, scale, causal, interpret):
 
 
 def _bwd_stream(q3, k3, v3, mask2, out3, lse, do3, heads, scale, causal,
-                interpret):
+                interpret, dlse=None):
     bh, t, d = q3.shape
     delta = jnp.sum(
         do3.astype(jnp.float32) * out3.astype(jnp.float32), axis=-1
     )[:, None, :]
+    if dlse is not None:
+        delta = delta - dlse  # see _bwd: lse cotangent folds into delta
     blk = _divisor_blk(t, _STREAM_BLK)
     nq, nk = t // blk, t // blk
     q_spec = pl.BlockSpec((1, blk, d), lambda b, i, j: (b, i, 0))
@@ -514,18 +537,40 @@ def _attend_bwd(heads, scale, causal, interpret, tier, res, do3):
 _attend.defvjp(_attend_fwd, _attend_bwd)
 
 
-def fused_attention(q, k, v, kv_mask=None, causal: bool = False, tier=None):
-    """Exact fused attention.  ``q/k/v: [B, T, H, D]`` (flax head layout),
-    ``kv_mask: [B, T]`` key-padding mask (True = attend) or None.  The
-    caller is responsible for eligibility (see :func:`kernel_tier`);
-    callers wanting automatic gating + fallback use :func:`attention_fn`.
-    ``tier`` overrides the automatic one-level/streaming choice (tests)."""
-    mode = _mode()
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _attend_lse(q3, k3, v3, mask2, heads, scale, causal, interpret, tier):
+    return _fwd_tier(tier, q3, k3, v3, mask2, heads, scale, causal, interpret)
+
+
+def _attend_lse_fwd(q3, k3, v3, mask2, heads, scale, causal, interpret, tier):
+    out, lse = _fwd_tier(
+        tier, q3, k3, v3, mask2, heads, scale, causal, interpret
+    )
+    return (out, lse), (q3, k3, v3, mask2, out, lse)
+
+
+def _attend_lse_bwd(heads, scale, causal, interpret, tier, res, cts):
+    q3, k3, v3, mask2, out, lse = res
+    do3, dlse = cts
+    dq, dk, dv = _bwd_tier(
+        tier, q3, k3, v3, mask2, out, lse, do3, heads, scale, causal,
+        interpret, dlse.astype(jnp.float32),
+    )
+    return dq, dk, dv, None
+
+
+_attend_lse.defvjp(_attend_lse_fwd, _attend_lse_bwd)
+
+
+def _prepare(q, k, v, kv_mask, tier):
+    """Shared wrapper preamble for both public entry points: tier
+    resolution, [B,T,H,D] -> padded [B*H, T_pad, D_pad] relayout, and the
+    f32 key-padding row.  ONE definition so the plain path
+    (``attention_fn``) and the lse path (ring merge) can never drift."""
     b, t, h, d = q.shape
     if tier is None:
         tier = kernel_tier(t, d, q.dtype.itemsize, _perf_gate=False)
     assert tier in ("fused", "stream"), f"ineligible shape T={t} D={d}"
-    scale = 1.0 / math.sqrt(d)
     t_pad = max(128, ((t + 127) // 128) * 128)
     # K/V loads and dq/dk/dv writes pay for padded D bytes: pad only to the
     # MXU's minimum useful contraction width, not always to a full lane
@@ -535,16 +580,43 @@ def fused_attention(q, k, v, kv_mask=None, causal: bool = False, tier=None):
         x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
         return jnp.pad(x, ((0, 0), (0, t_pad - t), (0, d_pad - d)))
 
-    q3, k3, v3 = to3(q), to3(k), to3(v)
     mask = jnp.ones((b, t), jnp.float32) if kv_mask is None else kv_mask.astype(
         jnp.float32
     )
     mask2 = jnp.pad(mask, ((0, 0), (0, t_pad - t)))[:, None, :]
+    scale = 1.0 / math.sqrt(d)
+    return tier, to3(q), to3(k), to3(v), mask2, scale
+
+
+def fused_attention(q, k, v, kv_mask=None, causal: bool = False, tier=None):
+    """Exact fused attention.  ``q/k/v: [B, T, H, D]`` (flax head layout),
+    ``kv_mask: [B, T]`` key-padding mask (True = attend) or None.  The
+    caller is responsible for eligibility (see :func:`kernel_tier`);
+    callers wanting automatic gating + fallback use :func:`attention_fn`.
+    ``tier`` overrides the automatic one-level/streaming choice (tests)."""
+    b, t, h, d = q.shape
+    tier, q3, k3, v3, mask2, scale = _prepare(q, k, v, kv_mask, tier)
     out = _attend(
-        q3, k3, v3, mask2, h, scale, causal, mode == "interpret", tier
+        q3, k3, v3, mask2, h, scale, causal, _mode() == "interpret", tier
     )
     out = out[:, :t, :d].reshape(b, h, t, d)
     return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def fused_attention_lse(q, k, v, kv_mask=None, causal: bool = False,
+                        tier=None):
+    """Like :func:`fused_attention` but ALSO returns the per-row
+    log-sum-exp ``[B, H, T]`` — the merge currency for composing partial
+    attention over key shards (``parallel/ring_attention.py`` combines
+    per-hop (out, lse) pairs).  Fully differentiable: the lse cotangent
+    folds into the shared backward kernels as ``delta - dlse``."""
+    b, t, h, d = q.shape
+    tier, q3, k3, v3, mask2, scale = _prepare(q, k, v, kv_mask, tier)
+    out, lse = _attend_lse(
+        q3, k3, v3, mask2, h, scale, causal, _mode() == "interpret", tier
+    )
+    out = out[:, :t, :d].reshape(b, h, t, d)
+    return jnp.transpose(out, (0, 2, 1, 3)), lse[:, 0, :t].reshape(b, h, t)
 
 
 _VMEM_BUDGET = 15 * 1024 * 1024  # leave headroom under the 16 MB scoped limit

@@ -76,7 +76,18 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, kv_mask=None):
     ``q/k/v: [B, T_local, H, D]`` (global sequence laid out in axis-index
     order), ``kv_mask: [B, T_local]`` key-padding mask or None.  Returns the
     local attention output ``[B, T_local, H, D]``.
+
+    When the per-device block is eligible for the fused Pallas kernel
+    (``ops/fused_attention.kernel_tier``; non-causal — causal cross-block
+    offsets stay on the jnp path) each hop's block attention runs as one
+    kernel call and hops merge differentiable ``(out, lse)`` pairs — the
+    composition that makes multi-chip long context ride the same kernel
+    as single-chip (the lse cotangent folds into the kernel's backward).
     """
+    from ..ops.fused_attention import kernel_tier
+
+    if not causal and kernel_tier(q.shape[1], q.shape[3], q.dtype.itemsize):
+        return _ring_attention_fused(q, k, v, axis_name, kv_mask)
     axis_size = jax.lax.psum(1, axis_name)
     my_index = jax.lax.axis_index(axis_name)
     batch, t_local, heads, dim = q.shape
@@ -141,6 +152,50 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, kv_mask=None):
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
+def _ring_attention_fused(q, k, v, axis_name: str, kv_mask):
+    """Ring hops over Pallas-fused block attention.  Each hop computes its
+    K/V block's partial ``(out, lse)`` with ``fused_attention_lse`` and the
+    carry merges the pairs with the standard log-sum-exp combination —
+    numerically identical to the online-softmax recurrence, and
+    differentiable end-to-end (scan over custom_vjp calls + ppermute)."""
+    from ..ops.fused_attention import fused_attention_lse
+
+    axis_size = jax.lax.psum(1, axis_name)
+    batch, t_local, _, _ = q.shape
+    mask0 = (
+        jnp.ones((batch, t_local), jnp.float32)
+        if kv_mask is None
+        else kv_mask.astype(jnp.float32)
+    )
+    o, lse = fused_attention_lse(q, k, v, kv_mask=mask0 != 0)
+    o = o.astype(jnp.float32)
+
+    if axis_size > 1:
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+        def step(carry, _):
+            o, lse, k_blk, v_blk, m_blk = carry
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            m_blk = jax.lax.ppermute(m_blk, axis_name, perm)
+            o_b, lse_b = fused_attention_lse(q, k_blk, v_blk, kv_mask=m_blk != 0)
+            m = jnp.maximum(lse, lse_b)  # [B, H, T]
+            w = jnp.exp(lse - m)
+            w_b = jnp.exp(lse_b - m)
+            denom = jnp.maximum(w + w_b, 1e-30)
+            align = lambda x: jnp.transpose(x, (0, 2, 1))[..., None]
+            o = o * align(w / denom) + o_b.astype(jnp.float32) * align(
+                w_b / denom
+            )
+            lse = m + jnp.log(denom)
+            return (o, lse, k_blk, v_blk, m_blk), None
+
+        (o, lse, _k, _v, _m), _ = jax.lax.scan(
+            step, (o, lse, k, v, mask0), None, length=axis_size - 1
+        )
+    return o.astype(q.dtype)
+
+
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False, kv_mask=None):
     """Exact attention via all-to-all sequence↔head re-sharding.
 
@@ -173,7 +228,15 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False, kv_mask=Non
         if kv_mask is not None
         else None
     )
-    out = dense_attention(qg, kg, vg, causal=causal, kv_mask=full_mask)
+    # after the all-to-all each device holds the FULL sequence for its
+    # heads — exactly the fused kernel's shape (causal is fine here:
+    # positions are global)
+    from ..ops.fused_attention import fused_attention, kernel_tier
+
+    if kernel_tier(qg.shape[1], qg.shape[3], qg.dtype.itemsize):
+        out = fused_attention(qg, kg, vg, kv_mask=full_mask, causal=causal)
+    else:
+        out = dense_attention(qg, kg, vg, causal=causal, kv_mask=full_mask)
     return head_to_seq(out)
 
 

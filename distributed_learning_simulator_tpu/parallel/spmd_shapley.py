@@ -192,8 +192,14 @@ class SpmdShapleySession(SpmdFedAvgSession):
             if os.path.isfile(path):
                 try:
                     with open(path, encoding="utf8") as f:
+                        # int-normalize BOTH key levels (round and worker
+                        # id) so restored rounds index identically to
+                        # freshly computed ones
                         target.update(
-                            {int(k): v for k, v in json.load(f).items()}
+                            {
+                                int(k): {int(w): sv for w, sv in v.items()}
+                                for k, v in json.load(f).items()
+                            }
                         )
                 except (json.JSONDecodeError, ValueError):
                     # a crash mid-write can only leave a stale-but-valid
