@@ -201,7 +201,7 @@ class SpmdShapleySession(SpmdFedAvgSession):
                                 for k, v in json.load(f).items()
                             }
                         )
-                except (json.JSONDecodeError, ValueError):
+                except (json.JSONDecodeError, ValueError, AttributeError, TypeError):
                     # a crash mid-write can only leave a stale-but-valid
                     # file (writes go through os.replace), but tolerate a
                     # corrupt one from any source: params/round still

@@ -92,8 +92,30 @@ def _build_task(
     assert len(practitioners) == config.worker_number
 
     dataset_collection = create_dataset_collection(config)
+    model_kwargs = dict(config.model_kwargs)
+    # ``model_kwargs.sequence_parallel: N`` — shard the model's sequence
+    # axis over an ("sp",) mesh of N devices (ring/Ulysses attention,
+    # ``parallel/ring_attention.py``).  Meshes can't ride YAML, so the
+    # config carries the axis SIZE and the mesh is built here; the model
+    # factory receives it as ``sp_mesh`` (``models/long_context.py``).
+    sequence_parallel = int(model_kwargs.pop("sequence_parallel", 0))
+    if sequence_parallel:
+        import jax
+        from jax.sharding import Mesh
+
+        devices = jax.devices()
+        if sequence_parallel > len(devices):
+            raise ValueError(
+                f"sequence_parallel={sequence_parallel} exceeds the "
+                f"{len(devices)}-device mesh"
+            )
+        import numpy as _np
+
+        model_kwargs["sp_mesh"] = Mesh(
+            _np.asarray(devices[:sequence_parallel]), axis_names=("sp",)
+        )
     model_ctx = create_model_context(
-        config.model_name, dataset_collection, **dict(config.model_kwargs)
+        config.model_name, dataset_collection, **model_kwargs
     )
     if config.use_amp:
         # reference use_amp (torch autocast) → bfloat16 compute on the MXU:
@@ -375,6 +397,16 @@ def resolve_executor(config) -> str:
         )
     if executor != "auto":
         return executor
+    if int(dict(config.model_kwargs).get("sequence_parallel", 0)):
+        # the SPMD round program shard_maps the CLIENT axis; a model whose
+        # forward shard_maps its own ("sp",) mesh cannot nest inside it —
+        # sequence-parallel clients train on the threaded executor, where
+        # the sp shard_map lives directly inside each client's jitted step
+        get_logger().info(
+            "executor auto: sequence_parallel set, using the threaded "
+            "executor (sp mesh owns the devices)"
+        )
+        return "sequential"
     if config.distributed_algorithm in SPMD_METHODS:
         return "spmd"
     get_logger().info(
@@ -386,6 +418,12 @@ def resolve_executor(config) -> str:
 
 
 def _make_spmd_session(ctx: TaskContext):
+    if int(dict(ctx.config.model_kwargs).get("sequence_parallel", 0)):
+        raise ValueError(
+            "sequence_parallel shards the model's OWN ('sp',) mesh and "
+            "cannot nest inside the SPMD client-axis round program; drop "
+            "executor=spmd (auto routes it to the threaded executor)"
+        )
     builder = SPMD_SESSION_BUILDERS.get(ctx.config.distributed_algorithm)
     if builder is None:
         raise NotImplementedError(
