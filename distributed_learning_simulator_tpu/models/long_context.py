@@ -6,7 +6,18 @@ the framework's long-context flagship: the sequence axis of a single
 client's forward/backward can be sharded over a mesh axis (``"sp"``) with
 exact attention computed by ring passes (``parallel/ring_attention.py``) or
 Ulysses all-to-alls.  On a single device (or ``sp_mesh=None``) it falls
-back to dense attention — same parameters, same math.
+back to fused/dense attention — same parameters, same math.
+
+Two sequence-parallel modes, same parameters:
+
+* ``sp_mesh`` — the model owns the mesh and wraps attention in its own
+  ``shard_map`` (full-array inputs; how the threaded executor shards a
+  client step, config ``model_kwargs.sequence_parallel``).
+* ``sp_axis`` — the model is ALREADY inside someone else's ``shard_map``
+  binding that axis (the SPMD sequence-parallel session,
+  ``parallel/spmd_sp.py``): inputs are LOCAL sequence blocks, attention
+  calls ring/Ulysses by axis name, positions offset by
+  ``lax.axis_index``, and the pooled read is a psum.
 """
 
 from typing import Any
@@ -22,19 +33,29 @@ class LongContextSelfAttention(nn.Module):
     nhead: int
     sp_mesh: Any = None  # jax Mesh with an "sp" axis, or None
     sp_impl: str = "ring"
+    sp_axis: str = ""  # inside an enclosing shard_map: attend by axis name
 
     @nn.compact
     def __call__(self, x, pad_mask):
         # deferred: models package is imported by engine, which parallel/
         # also imports (package-level cycle)
         from ..ops.fused_attention import fused_attention, kernel_eligible
-        from ..parallel.ring_attention import dense_attention, sharded_attention
+        from ..parallel.ring_attention import (
+            dense_attention,
+            ring_attention,
+            sharded_attention,
+            ulysses_attention,
+        )
 
         batch, length, _ = x.shape
         head_dim = self.d_model // self.nhead
         qkv = nn.DenseGeneral((3, self.nhead, head_dim), name="qkv")(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if self.sp_mesh is None:
+        if self.sp_axis:
+            # local blocks of a sequence sharded by the CALLER's shard_map
+            inner = ring_attention if self.sp_impl == "ring" else ulysses_attention
+            out = inner(q, k, v, axis_name=self.sp_axis, kv_mask=pad_mask)
+        elif self.sp_mesh is None:
             if kernel_eligible(length, head_dim, q.dtype.itemsize):
                 # single-device long sequence: the Pallas fused kernel
                 # (scores never hit HBM — 1.4x+ over XLA at seq 8k)
@@ -55,18 +76,36 @@ class LongContextEncoderLayer(nn.Module):
     nhead: int
     sp_mesh: Any = None
     sp_impl: str = "ring"
+    sp_axis: str = ""
     dropout_rate: float = 0.1
+
+    def _drop_rng(self, train: bool):
+        """In sp_axis mode every shard sees the SAME flax rng stream —
+        without decorrelation the positionwise dropout mask would repeat
+        per sequence block.  Fold the shard index in so masks are
+        independent across shards."""
+        import jax
+
+        if not train or self.dropout_rate == 0.0 or not self.sp_axis:
+            return None
+        return jax.random.fold_in(
+            self.make_rng("dropout"), jax.lax.axis_index(self.sp_axis)
+        )
 
     @nn.compact
     def __call__(self, x, pad_mask, train: bool = False):
         y = LongContextSelfAttention(
-            self.d_model, self.nhead, self.sp_mesh, self.sp_impl
+            self.d_model, self.nhead, self.sp_mesh, self.sp_impl, self.sp_axis
         )(nn.LayerNorm()(x), pad_mask)
-        x = x + nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        x = x + nn.Dropout(self.dropout_rate, deterministic=not train)(
+            y, rng=self._drop_rng(train)
+        )
         y = nn.Dense(4 * self.d_model)(nn.LayerNorm()(x))
         y = nn.gelu(y)
         y = nn.Dense(self.d_model)(y)
-        return x + nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        return x + nn.Dropout(self.dropout_rate, deterministic=not train)(
+            y, rng=self._drop_rng(train)
+        )
 
 
 class LongContextTransformer(nn.Module):
@@ -79,22 +118,47 @@ class LongContextTransformer(nn.Module):
     pad_id: int = 0
     sp_mesh: Any = None
     sp_impl: str = "ring"
+    sp_axis: str = ""
+    dropout_rate: float = 0.1
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
-        pad_mask = tokens != self.pad_id  # [B, L]
+        import jax
+        import jax.numpy as jnp
+
+        pad_mask = tokens != self.pad_id  # [B, L_local when sp_axis]
         x = nn.Embed(self.vocab_size, self.d_model)(tokens)
         # dtype-matched add: keep the bf16 compute path under use_amp (an
         # f32 positional constant would promote every layer back to f32)
-        x = x + sinusoidal_positions(self.max_len, self.d_model)[
-            None, : tokens.shape[1]
-        ].astype(x.dtype)
+        pos = sinusoidal_positions(self.max_len, self.d_model)
+        if self.sp_axis:
+            # tokens are a LOCAL block: global positions start at this
+            # shard's offset along the sequence axis
+            start = jax.lax.axis_index(self.sp_axis) * tokens.shape[1]
+            x = x + jax.lax.dynamic_slice(
+                jnp.asarray(pos, x.dtype),
+                (start, 0),
+                (tokens.shape[1], self.d_model),
+            )[None]
+        else:
+            x = x + pos[None, : tokens.shape[1]].astype(x.dtype)
         for _ in range(self.num_encoder_layer):
             x = LongContextEncoderLayer(
-                self.d_model, self.nhead, self.sp_mesh, self.sp_impl
+                self.d_model, self.nhead, self.sp_mesh, self.sp_impl,
+                self.sp_axis, self.dropout_rate,
             )(x, pad_mask, train=train)
         x = nn.LayerNorm()(x)
-        pooled = masked_mean_pool(x, pad_mask)
+        if self.sp_axis:
+            # global masked mean: both sums cross the sequence shards
+            num = jax.lax.psum(
+                (x * pad_mask[..., None]).sum(axis=1), self.sp_axis
+            )
+            den = jax.lax.psum(
+                pad_mask.sum(axis=1, keepdims=True), self.sp_axis
+            )
+            pooled = num / jnp.maximum(den, 1)
+        else:
+            pooled = masked_mean_pool(x, pad_mask)
         return nn.Dense(self.num_classes)(pooled)
 
 
@@ -107,6 +171,8 @@ def _long_context_transformer(
     max_len: int = 0,
     sp_mesh: Any = None,
     sp_impl: str = "ring",
+    sp_axis: str = "",
+    dropout_rate: float = 0.1,
     **kwargs,
 ) -> ModelContext:
     meta = dataset_collection.metadata
@@ -120,6 +186,8 @@ def _long_context_transformer(
         pad_id=meta.get("pad_id", 0),
         sp_mesh=sp_mesh,
         sp_impl=sp_impl,
+        sp_axis=sp_axis,
+        dropout_rate=dropout_rate,
     )
     return ModelContext(
         name="LongContextTransformer",

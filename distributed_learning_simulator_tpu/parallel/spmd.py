@@ -185,6 +185,10 @@ class SpmdFedAvgSession:
             and type(self) is SpmdFedAvgSession
         )
         slot_axes = ("clients", "model") if self._fsdp else ("clients",)
+        # a session may bring a mesh without a clients axis (the
+        # sequence-parallel session's ("sp",) mesh gives every device to
+        # ONE client's model; clients are then a scan, not an axis)
+        slot_axes = tuple(a for a in slot_axes if a in self.mesh.shape)
         self.n_slots = client_slots(config.worker_number, self.mesh, slot_axes)
         self.quantization_level = quantization_level
         self.client_chunk = client_chunk or int(
@@ -203,7 +207,12 @@ class SpmdFedAvgSession:
         )
 
         # ---- shardings ----
-        self._slot_spec = P(("clients", "model")) if self._fsdp else P("clients")
+        if self._fsdp:
+            self._slot_spec = P(("clients", "model"))
+        elif "clients" in self.mesh.shape:
+            self._slot_spec = P("clients")
+        else:
+            self._slot_spec = P()  # clients-as-scan meshes: slots replicated
         self._client_sharding = NamedSharding(self.mesh, self._slot_spec)
         self._replicated = NamedSharding(self.mesh, P())
         template = jax.eval_shape(

@@ -1,0 +1,189 @@
+"""FedAvg rounds with sequence-parallel clients as one SPMD program.
+
+The client-axis sessions (``spmd.py``) shard CLIENTS over the mesh; this
+session gives the whole mesh to each client's MODEL instead: an
+``("sp",)`` mesh shards the sequence axis, clients train one after
+another inside the round program (``lax.scan``), and the weighted
+aggregation accumulates on device.  This is the SPMD home of
+``model_kwargs.sequence_parallel`` — the threaded executor supports the
+same knob by letting the model own an ``sp_mesh``; here the SESSION owns
+the one ``shard_map`` and the model runs in its ``sp_axis`` mode (local
+blocks, ring/Ulysses by axis name, psum pooling —
+``models/long_context.py``).
+
+Design notes:
+
+* The run loop, selection, eval, round records, checkpoints, watchdog,
+  and resume are ALL inherited from ``SpmdFedAvgSession`` — this class
+  only changes how a round's device program is laid out.  The rng stream
+  is therefore identical to the client-axis session's, which is what the
+  equivalence test pins (sp=1 matches ``SpmdFedAvgSession`` to float
+  accumulation order).
+* Unselected clients still flow through the scan (masked to weight 0) —
+  SPMD needs a uniform program; with the few-but-huge clients this
+  session targets, the waste is bounded by the selection ratio.
+* Central evaluation uses the UNSHARDED engine (single-device semantics,
+  Pallas fused/streaming attention at long sequence) — the sp-mode model
+  shares its parameter structure exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine.engine import ComputeEngine
+from .mesh import put_sharded
+from .spmd import SpmdFedAvgSession, scan_local_epochs, shard_map_compat
+
+
+class SpmdSequenceParallelSession(SpmdFedAvgSession):
+    def __init__(
+        self,
+        config,
+        dataset_collection,
+        model_ctx,
+        engine: ComputeEngine,
+        practitioners,
+        sequence_parallel: int,
+        sp_impl: str = "ring",
+    ) -> None:
+        devices = jax.devices()
+        if sequence_parallel > len(devices):
+            raise ValueError(
+                f"sequence_parallel={sequence_parallel} exceeds the "
+                f"{len(devices)}-device mesh"
+            )
+        sp_mesh = Mesh(
+            np.asarray(devices[:sequence_parallel]), axis_names=("sp",)
+        )
+        # the sp-mode twin: same factory, same parameter structure, forward
+        # written for local blocks inside THIS session's shard_map
+        from ..models import create_model_context
+
+        kwargs = dict(getattr(config, "model_kwargs", {}) or {})
+        kwargs.pop("sequence_parallel", None)
+        kwargs.pop("sp_mesh", None)
+        kwargs["sp_axis"] = "sp"
+        kwargs.setdefault("sp_impl", sp_impl)
+        sp_model_ctx = create_model_context(
+            config.model_name, dataset_collection, **kwargs
+        )
+        sp_model_ctx.compute_dtype = model_ctx.compute_dtype
+        self._sp_engine = ComputeEngine(
+            sp_model_ctx, engine.hyper_parameter, total_steps=engine.total_steps
+        )
+        super().__init__(
+            config, dataset_collection, model_ctx, engine, practitioners,
+            mesh=sp_mesh,
+        )
+        # the base placed the stacked client data replicated (no clients
+        # axis in this mesh); re-place the sequence-bearing leaves sharded
+        # over "sp" so each device holds only its blocks
+        self._data = {
+            k: jax.device_put(
+                v,
+                NamedSharding(
+                    self.mesh,
+                    P(None, None, None, "sp") if v.ndim >= 4 else P(),
+                ),
+            )
+            for k, v in self._data.items()
+        }
+
+    def _leaf_spec(self, shape) -> P:
+        return P()  # params replicated; the sequence axis is the sharded one
+
+    def _build_round_fn(self):
+        engine = self._sp_engine
+        epochs = self.config.epoch
+        mesh = self.mesh
+
+        # shape templates for the scan's running accumulator — traced with
+        # the UNSHARDED engine: the sp-mode twin needs a bound "sp" axis
+        # (its forward calls axis_index/psum) and only runs inside the
+        # round program's shard_map; param/metric STRUCTURES are identical
+        outer_engine = self.engine
+        params_shape = jax.eval_shape(
+            lambda: outer_engine.init_params(self.config.seed)
+        )
+        cdata_shape = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), self._data
+        )
+        metrics_shape = jax.eval_shape(
+            lambda gp, cd, rng: scan_local_epochs(
+                outer_engine, epochs, gp, cd, rng
+            )[1],
+            params_shape,
+            cdata_shape,
+            jax.ShapeDtypeStruct((2,), jnp.uint32),
+        )
+
+        def round_program(global_params, weights, rngs, data):
+            def shard_body(global_params, data, weights, rngs):
+                # data leaves here are LOCAL sequence blocks ([C, nb, B, L/sp]
+                # for the token input); params/weights/rngs are replicated
+
+                def body(acc, xs):
+                    cdata, weight, rng = xs
+                    params, summed = scan_local_epochs(
+                        engine, epochs, global_params, cdata, rng
+                    )
+                    acc_params, acc_metrics = acc
+                    acc_params = jax.tree.map(
+                        lambda a, p: a + p.astype(jnp.float32) * weight,
+                        acc_params,
+                        params,
+                    )
+                    selected = (weight > 0).astype(jnp.float32)
+                    acc_metrics = jax.tree.map(
+                        lambda a, m: a + m * selected, acc_metrics, summed
+                    )
+                    return (acc_params, acc_metrics), None
+
+                zero_params = jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, jnp.float32), params_shape
+                )
+                zero_metrics = jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), metrics_shape
+                )
+                (acc_params, metrics), _ = jax.lax.scan(
+                    body, (zero_params, zero_metrics), (data, weights, rngs)
+                )
+                total = jnp.maximum(jnp.sum(weights), 1e-12)
+                new_global = jax.tree.map(
+                    lambda a, g: (a / total).astype(g.dtype),
+                    acc_params,
+                    global_params,
+                )
+                return new_global, metrics
+
+            data_specs = jax.tree.map(
+                lambda x: P(None, None, None, "sp")
+                if x.ndim >= 4
+                else P(),
+                data,
+            )
+            return shard_map_compat(
+                shard_body,
+                mesh,
+                in_specs=(P(), data_specs, P(), P()),
+                out_specs=(P(), P()),
+            )(global_params, data, weights, rngs)
+
+        jitted = jax.jit(round_program, donate_argnums=(0,))
+
+        def fn(global_params, weights, rngs):
+            return jitted(global_params, weights, rngs, self._data)
+
+        return fn
+
+
+def build_sequence_parallel_session(ctx, session_args, session_kwargs):
+    config = ctx.config
+    model_kwargs = dict(config.model_kwargs)
+    return SpmdSequenceParallelSession(
+        *session_args,
+        sequence_parallel=int(model_kwargs.get("sequence_parallel", 0)),
+        sp_impl=str(model_kwargs.get("sp_impl", "ring")),
+    )

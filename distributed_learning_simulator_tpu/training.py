@@ -398,13 +398,16 @@ def resolve_executor(config) -> str:
     if executor != "auto":
         return executor
     if int(dict(config.model_kwargs).get("sequence_parallel", 0)):
-        # the SPMD round program shard_maps the CLIENT axis; a model whose
-        # forward shard_maps its own ("sp",) mesh cannot nest inside it —
-        # sequence-parallel clients train on the threaded executor, where
-        # the sp shard_map lives directly inside each client's jitted step
+        if config.distributed_algorithm == "fed_avg":
+            # dedicated SPMD session: the ("sp",) mesh shards each client's
+            # sequence axis, clients scan inside one round program
+            return "spmd"
+        # other methods: the threaded executor, where each client's jitted
+        # step owns the model's sp shard_map
         get_logger().info(
             "executor auto: sequence_parallel set, using the threaded "
-            "executor (sp mesh owns the devices)"
+            "executor for %r (sp mesh owns the devices)",
+            config.distributed_algorithm,
         )
         return "sequential"
     if config.distributed_algorithm in SPMD_METHODS:
@@ -419,11 +422,23 @@ def resolve_executor(config) -> str:
 
 def _make_spmd_session(ctx: TaskContext):
     if int(dict(ctx.config.model_kwargs).get("sequence_parallel", 0)):
-        raise ValueError(
-            "sequence_parallel shards the model's OWN ('sp',) mesh and "
-            "cannot nest inside the SPMD client-axis round program; drop "
-            "executor=spmd (auto routes it to the threaded executor)"
+        if ctx.config.distributed_algorithm != "fed_avg":
+            raise ValueError(
+                "sequence_parallel under executor=spmd is implemented for "
+                "fed_avg (parallel/spmd_sp.py); other methods run it on "
+                "the threaded executor, where each client's jitted step "
+                "owns the model's sp shard_map (executor auto does this)"
+            )
+        from .parallel.spmd_sp import build_sequence_parallel_session
+
+        session_args = (
+            ctx.config,
+            ctx.dataset_collection,
+            ctx.model_ctx,
+            ctx.engine,
+            ctx.practitioners,
         )
+        return build_sequence_parallel_session(ctx, session_args, {})
     builder = SPMD_SESSION_BUILDERS.get(ctx.config.distributed_algorithm)
     if builder is None:
         raise NotImplementedError(

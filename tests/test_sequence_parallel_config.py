@@ -41,22 +41,48 @@ def _config(**model_extra):
 def test_sequence_parallel_from_config_matches_unsharded():
     """Same seeds, same math: the sp=4 run's metrics equal the unsharded
     run's up to ring-accumulation float order (ring attention is exact).
-    Both runs pin the threaded executor — sequence_parallel routes there
-    anyway, and an unsharded ``auto`` run would take the SPMD path whose
-    trajectory differs by executor, not by sharding."""
+    Both runs pin the threaded executor (this test validates the
+    model-owned ``sp_mesh`` mode; the SPMD sp session has its own
+    equivalence test below) — mixing executors would compare trajectories
+    that differ by executor, not by sharding."""
     base_config = _config()
     base_config.executor = "sequential"
     base = train(base_config)
-    sp = train(_config(sequence_parallel=4))
+    sp_config = _config(sequence_parallel=4)
+    sp_config.executor = "sequential"
+    sp = train(sp_config)
     for key in ("test_loss", "test_accuracy"):
         np.testing.assert_allclose(
             sp["performance"][1][key], base["performance"][1][key], atol=2e-4
         )
 
 
-def test_sequence_parallel_rejects_spmd_executor():
+def test_spmd_sequence_parallel_session_matches_client_axis_session():
+    """fed_avg + sequence_parallel under executor spmd runs the dedicated
+    SP session (whole mesh to each client's model, clients scanned).  At
+    worker_number == n_slots both sessions consume the IDENTICAL rng
+    stream, and ring attention is exact — so the two layouts must produce
+    the same trajectory to float accumulation order."""
+    base_config = _config()
+    base_config.executor = "spmd"
+    base_config.worker_number = 8
+    base = train(base_config)
+
+    sp_config = _config(sequence_parallel=4)
+    sp_config.executor = "spmd"
+    sp_config.worker_number = 8
+    sp = train(sp_config)
+    for key in ("test_loss", "test_accuracy"):
+        np.testing.assert_allclose(
+            sp["performance"][1][key], base["performance"][1][key], atol=2e-4
+        )
+
+
+def test_sequence_parallel_rejects_spmd_for_other_methods():
     config = _config(sequence_parallel=4)
     config.executor = "spmd"
+    config.distributed_algorithm = "fed_paq"
+    config.endpoint_kwargs = {"worker": {"quantization_level": 255}}
     with pytest.raises(ValueError, match="sequence_parallel"):
         train(config)
 
