@@ -29,10 +29,17 @@ class ComputeEngine:
         model_ctx: ModelContext,
         hyper_parameter: HyperParameter,
         total_steps: int,
+        grad_sync_axis: str = "",
     ) -> None:
         self.model_ctx = model_ctx
         self.hyper_parameter = hyper_parameter
         self.total_steps = max(1, total_steps)
+        # when the engine runs INSIDE a shard_map that shards the model's
+        # compute (sequence parallelism: each device computes a partial
+        # backward), gradients must be reduced over that axis before the
+        # optimizer update — pmean here, with the model's pooling boundary
+        # making pmean uniformly correct (parallel/collectives.py)
+        self.grad_sync_axis = grad_sync_axis
         self.optimizer = hyper_parameter.make_optimizer(self.total_steps)
         self.schedule = hyper_parameter.make_schedule(self.total_steps)
         # rematerialization for large client models (ViT/BERT-scale):
@@ -63,6 +70,8 @@ class ComputeEngine:
 
     def train_step_fn(self, params, opt_state, batch, rng):
         (loss, aux), grads = self.loss_and_grad(params, batch, rng)
+        if self.grad_sync_axis:
+            grads = jax.lax.pmean(grads, self.grad_sync_axis)
         updates, opt_state = self.optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         metrics = {

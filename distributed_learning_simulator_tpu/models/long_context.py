@@ -71,6 +71,62 @@ class LongContextSelfAttention(nn.Module):
         return nn.Dense(self.d_model, name="out")(out)
 
 
+class Dropout(nn.Dropout):
+    """``nn.Dropout`` that is exact under sequence sharding.
+
+    In ``sp_axis`` mode the layer sees a LOCAL block ``[B, L/sp, D]`` of
+    the sequence, but equivalence with the unsharded model (pinned by
+    ``tests/test_sequence_parallel_config.py``) requires the SAME mask
+    bits the unsharded model would draw for the full ``[B, L, D]``
+    tensor.  Mask bits for a sub-block are not locally derivable from a
+    threefry stream, so each shard draws the full-length mask and slices
+    its block — same rng call (one ``make_rng`` inside a module whose
+    auto-name matches ``nn.Dropout``'s), same ``bernoulli`` call, same
+    select arithmetic as flax's.  Cost: a transient ``[B, L, D]`` bool
+    per dropout site; long-context configs that care run dropout 0.
+
+    The class is named ``Dropout`` ON PURPOSE: flax auto-names children
+    ``{cls.__name__}_{i}``, and ``make_rng`` folds the module path into
+    the key — the sp and non-sp layouts must produce identical paths.
+    """
+
+    sp_axis: str = ""
+
+    @nn.compact
+    def __call__(self, inputs, deterministic=None, rng=None):
+        import jax.numpy as jnp
+        from jax import lax, random
+
+        if self.broadcast_dims:
+            raise NotImplementedError(
+                "this Dropout replicates flax's full-shape mask exactly "
+                "(sp-sliceable); broadcast_dims is not supported"
+            )
+        deterministic = nn.merge_param(
+            "deterministic", self.deterministic, deterministic
+        )
+        if self.rate == 0.0 or deterministic:
+            return inputs
+        if self.rate == 1.0:
+            return jnp.zeros_like(inputs)
+        keep_prob = 1.0 - self.rate
+        if rng is None:
+            rng = self.make_rng(self.rng_collection)
+        if not self.sp_axis:
+            mask = random.bernoulli(rng, p=keep_prob, shape=inputs.shape)
+        else:
+            batch, local_len, width = inputs.shape
+            sp = lax.psum(1, self.sp_axis)
+            start = lax.axis_index(self.sp_axis) * local_len
+            full_mask = random.bernoulli(
+                rng, p=keep_prob, shape=(batch, local_len * sp, width)
+            )
+            mask = lax.dynamic_slice(
+                full_mask, (0, start, 0), (batch, local_len, width)
+            )
+        return lax.select(mask, inputs / keep_prob, jnp.zeros_like(inputs))
+
+
 class LongContextEncoderLayer(nn.Module):
     d_model: int
     nhead: int
@@ -79,33 +135,20 @@ class LongContextEncoderLayer(nn.Module):
     sp_axis: str = ""
     dropout_rate: float = 0.1
 
-    def _drop_rng(self, train: bool):
-        """In sp_axis mode every shard sees the SAME flax rng stream —
-        without decorrelation the positionwise dropout mask would repeat
-        per sequence block.  Fold the shard index in so masks are
-        independent across shards."""
-        import jax
-
-        if not train or self.dropout_rate == 0.0 or not self.sp_axis:
-            return None
-        return jax.random.fold_in(
-            self.make_rng("dropout"), jax.lax.axis_index(self.sp_axis)
-        )
-
     @nn.compact
     def __call__(self, x, pad_mask, train: bool = False):
         y = LongContextSelfAttention(
             self.d_model, self.nhead, self.sp_mesh, self.sp_impl, self.sp_axis
         )(nn.LayerNorm()(x), pad_mask)
-        x = x + nn.Dropout(self.dropout_rate, deterministic=not train)(
-            y, rng=self._drop_rng(train)
-        )
+        x = x + Dropout(
+            self.dropout_rate, deterministic=not train, sp_axis=self.sp_axis
+        )(y)
         y = nn.Dense(4 * self.d_model)(nn.LayerNorm()(x))
         y = nn.gelu(y)
         y = nn.Dense(self.d_model)(y)
-        return x + nn.Dropout(self.dropout_rate, deterministic=not train)(
-            y, rng=self._drop_rng(train)
-        )
+        return x + Dropout(
+            self.dropout_rate, deterministic=not train, sp_axis=self.sp_axis
+        )(y)
 
 
 class LongContextTransformer(nn.Module):
@@ -149,8 +192,14 @@ class LongContextTransformer(nn.Module):
             )(x, pad_mask, train=train)
         x = nn.LayerNorm()(x)
         if self.sp_axis:
-            # global masked mean: both sums cross the sequence shards
-            num = jax.lax.psum(
+            # global masked mean: both sums cross the sequence shards.  The
+            # activation sum rides psum_symmetric so that a pmean over the
+            # whole gradient tree (engine ``grad_sync_axis`` —
+            # ``parallel/collectives.py`` derives why) is correct for both
+            # pre-pool (shard-partial) and post-pool (replicated) params.
+            from ..parallel.collectives import psum_symmetric
+
+            num = psum_symmetric(
                 (x * pad_mask[..., None]).sum(axis=1), self.sp_axis
             )
             den = jax.lax.psum(

@@ -103,10 +103,11 @@ def _mode() -> str:
     env = os.environ.get("DLS_TPU_FUSED_ATTN", "")
     if env == "off":
         return "off"
+    if env == "interpret":
+        # explicit override wins even on a TPU backend (kernel debugging)
+        return "interpret"
     if jax.default_backend() == "tpu":
         return "tpu"
-    if env == "interpret":
-        return "interpret"
     return "off"
 
 

@@ -1,0 +1,44 @@
+"""Axis-name collectives with non-default gradient rules.
+
+``psum_symmetric`` is the boundary piece of the SPMD sequence-parallel
+gradient story (``parallel/spmd_sp.py``): the sp-mode model pools its
+sequence-sharded activations with a psum, which makes every parameter
+DOWNSTREAM of the pool see replicated values (its per-device gradient is
+already the full gradient) while every parameter UPSTREAM contributes
+only its shard's partial gradient.  No single uniform reduction of the
+gradient tree fixes both — unless the pooling boundary rescales the
+upstream cotangent by the axis size.  Forward ``psum``, backward
+``psum`` (the cotangent is replicated, so the backward psum is exactly
+a multiply by the axis size) makes upstream per-device grads equal
+``sp * partial``; a ``pmean`` over the whole gradient tree then yields
+the correct total gradient for BOTH sides:
+
+* upstream leaf: ``pmean_d(sp * partial_d) = sum_d partial_d``  (total)
+* downstream leaf: ``pmean_d(full) = full``
+
+The reference has no analogue (its data parallelism all-reduces
+homogeneous grads over NCCL); this rule exists because sequence
+parallelism mixes sharded and replicated compute in one backward.
+"""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_symmetric(x, axis_name):
+    """``lax.psum`` whose transpose is also a ``psum`` (equivalently: the
+    backward multiplies the replicated cotangent by the axis size)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def _psum_symmetric_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _psum_symmetric_bwd(axis_name, _, ct):
+    return (jax.lax.psum(ct, axis_name),)
+
+
+psum_symmetric.defvjp(_psum_symmetric_fwd, _psum_symmetric_bwd)

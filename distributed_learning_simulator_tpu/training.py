@@ -99,6 +99,11 @@ def _build_task(
     # config carries the axis SIZE and the mesh is built here; the model
     # factory receives it as ``sp_mesh`` (``models/long_context.py``).
     sequence_parallel = int(model_kwargs.pop("sequence_parallel", 0))
+    if sequence_parallel and resolve_executor(config) == "spmd":
+        # the SPMD SP session owns the mesh (parallel/spmd_sp.py builds an
+        # sp-mode twin); the task's model_ctx stays mesh-free so central
+        # evaluation runs the documented UNSHARDED fused/streaming path
+        sequence_parallel = 0
     if sequence_parallel:
         import jax
         from jax.sharding import Mesh
