@@ -9,6 +9,8 @@ pretrained vectors; otherwise embeddings are learned from scratch (same
 shape — zero egress means no download path).
 """
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
@@ -85,6 +87,17 @@ class EncoderLayer(nn.Module):
 
 
 class TransformerClassifier(nn.Module):
+    """``pipeline_stages`` switches the encoder trunk to a STACKED layout
+    (one ``[num_encoder_layer, ...]`` parameter pytree, every layer
+    homogeneous) executed in microbatches: sequentially when
+    ``pp_mesh is None`` or ``pipeline_stages == 1``, as a GPipe schedule
+    over the mesh's ``pp`` axis otherwise (``parallel/pipeline.py`` —
+    ``lax.ppermute`` stage handoffs, one ``lax.scan`` of ticks).  Both
+    executions share parameters AND per-(layer, microbatch) dropout
+    streams, so ``stages=S`` matches ``stages=1`` to float accumulation
+    order (``tests/test_pipeline_config.py``).  ``pipeline_stages=0``
+    (default) keeps the original per-layer module layout."""
+
     vocab_size: int
     num_classes: int
     d_model: int = 100
@@ -92,6 +105,115 @@ class TransformerClassifier(nn.Module):
     num_encoder_layer: int = 2
     max_len: int = 300
     pad_id: int = 0
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 0
+    pp_mesh: Any = None
+
+    def _layer(self) -> EncoderLayer:
+        return EncoderLayer(self.d_model, self.nhead, 4 * self.d_model)
+
+    def _trunk_stacked(self, x, pad_mask, train: bool):
+        import jax
+        from jax import lax
+
+        n_layers = self.num_encoder_layer
+        stages = self.pipeline_stages
+        if n_layers % stages:
+            raise ValueError(
+                f"pipeline_stages={stages} must divide "
+                f"num_encoder_layer={n_layers}"
+            )
+        layer = self._layer()
+        batch, seq, width = x.shape
+
+        def init_trunk(rng):
+            def init_one(r):
+                return layer.init(
+                    {"params": r},
+                    jnp.zeros((1, seq, width), jnp.float32),
+                    jnp.ones((1, seq), bool),
+                    train=False,
+                )["params"]
+
+            return jax.vmap(init_one)(jax.random.split(rng, n_layers))
+
+        trunk = self.param("trunk", init_trunk)
+        base_rng = (
+            self.make_rng("dropout") if train else jax.random.PRNGKey(0)
+        )
+
+        n_micro = self.pipeline_microbatches or stages
+        if batch % n_micro:
+            # the engine's batches are uniformly padded (make_epoch_batches),
+            # so the only legitimate non-divisible batch is init's [1]
+            # example — anything else is a config error, not a fallback
+            if batch > 1:
+                raise ValueError(
+                    f"batch size {batch} is not divisible by "
+                    f"pipeline_microbatches={n_micro}"
+                )
+            n_micro = 1
+
+        def apply_layer(x_mb, valid_mb, p_j, rng_mb, global_layer):
+            rngs = (
+                {"dropout": jax.random.fold_in(rng_mb, global_layer)}
+                if train
+                else None
+            )
+            return layer.apply(
+                {"params": p_j}, x_mb, valid_mb, train=train, rngs=rngs
+            )
+
+        from ..parallel.pipeline import split_microbatches
+
+        micro_in = split_microbatches({"x": x, "pad": ~pad_mask}, n_micro)
+        xs, pads = micro_in["x"], micro_in["pad"]
+        rngs_mb = jax.vmap(jax.random.fold_in, (None, 0))(
+            base_rng, jnp.arange(n_micro)
+        )
+
+        if self.pp_mesh is None or stages == 1 or n_micro == 1:
+
+            def run_mb(args):
+                x_mb, pad_mb, rng_mb = args
+
+                def body(xc, inp):
+                    j, p_j = inp
+                    return apply_layer(xc, ~pad_mb, p_j, rng_mb, j), None
+
+                out, _ = lax.scan(body, x_mb, (jnp.arange(n_layers), trunk))
+                return out
+
+            out = lax.map(run_mb, (xs, pads, rngs_mb))
+            return out.reshape(batch, seq, width)
+
+        from ..parallel.pipeline import pipeline_apply
+
+        lps = n_layers // stages
+        stage_params = jax.tree.map(
+            lambda p: p.reshape(stages, lps, *p.shape[1:]), trunk
+        )
+
+        def stage_fn(params_here, carry):
+            # carry["pad"] is nonzero on PAD positions (uint8: the schedule
+            # psums the carry, which rejects bools) so the bubble ticks'
+            # all-zeros feed means "everything valid" — an all-False
+            # validity mask would drive softmax to NaN and poison the
+            # masked-out gradients through jnp.where
+            s_idx = lax.axis_index("pp")
+            valid = carry["pad"] == 0
+
+            def body(xc, inp):
+                j, p_j = inp
+                g = s_idx * lps + j
+                return apply_layer(xc, valid, p_j, carry["rng"], g), None
+
+            out, _ = lax.scan(body, carry["x"], (jnp.arange(lps), params_here))
+            return {"x": out, "pad": carry["pad"], "rng": carry["rng"]}
+
+        micro = {"x": xs, "pad": pads.astype(jnp.uint8), "rng": rngs_mb}
+        result = pipeline_apply(stage_fn, stage_params, micro, self.pp_mesh)
+        return result["x"].reshape(batch, seq, width)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -103,10 +225,13 @@ class TransformerClassifier(nn.Module):
         x = x + sinusoidal_positions(self.max_len, self.d_model)[
             None, : tokens.shape[1]
         ].astype(x.dtype)
-        for _ in range(self.num_encoder_layer):
-            x = EncoderLayer(self.d_model, self.nhead, 4 * self.d_model)(
-                x, pad_mask, train=train
-            )
+        if self.pipeline_stages:
+            x = self._trunk_stacked(x, pad_mask, train)
+        else:
+            for _ in range(self.num_encoder_layer):
+                x = EncoderLayer(self.d_model, self.nhead, 4 * self.d_model)(
+                    x, pad_mask, train=train
+                )
         pooled = masked_mean_pool(x, pad_mask)
         return nn.Dense(self.num_classes)(pooled)
 
@@ -119,6 +244,9 @@ def _transformer(
     num_encoder_layer: int = 2,
     max_len: int = 0,
     word_vector_name: str = "",
+    pipeline_stages: int = 0,
+    pipeline_microbatches: int = 0,
+    pp_mesh: Any = None,
     **kwargs,
 ) -> ModelContext:
     meta = dataset_collection.metadata
@@ -130,6 +258,9 @@ def _transformer(
         num_encoder_layer=num_encoder_layer,
         max_len=max_len or meta.get("max_len", 300),
         pad_id=meta.get("pad_id", 0),
+        pipeline_stages=pipeline_stages,
+        pipeline_microbatches=pipeline_microbatches,
+        pp_mesh=pp_mesh,
     )
     # pretrained embedding init when both the ingested vectors and the
     # dataset's vocab are on disk (reference: word_vector_name, torchtext

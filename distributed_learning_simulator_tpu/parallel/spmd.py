@@ -133,6 +133,81 @@ def scan_local_epochs_carry(
     return params, opt_state, jax.tree.map(lambda x: jnp.sum(x), metrics)
 
 
+def whole_mesh_session_shapes(session):
+    """Trace-time (params, metrics) shape templates for sessions that give
+    the WHOLE mesh to one client at a time (sequence-parallel, expert-
+    parallel): traced with the session's UNSHARDED engine — the sharded
+    twin may need a bound mesh axis, and the structures are identical."""
+    outer_engine = session.engine
+    params_shape = jax.eval_shape(
+        lambda: outer_engine.init_params(session.config.seed)
+    )
+    cdata_shape = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), session._data
+    )
+    metrics_shape = jax.eval_shape(
+        lambda gp, cd, rng: scan_local_epochs(
+            outer_engine, session.config.epoch, gp, cd, rng
+        )[1],
+        params_shape,
+        cdata_shape,
+        jax.ShapeDtypeStruct((2,), jnp.uint32),
+    )
+    return params_shape, metrics_shape
+
+
+def scan_weighted_clients(
+    engine,
+    epochs: int,
+    global_params,
+    data,
+    weights,
+    rngs,
+    params_shape,
+    metrics_shape,
+):
+    """Clients one after another as a ``lax.scan`` (the round body of the
+    whole-mesh-per-client sessions, ``spmd_sp.py``/``spmd_ep.py``), with
+    the client-axis rng contract: each client reserves a quant rng before
+    training even when no codec is configured, so trajectories match the
+    client-axis session's to float order (the equivalence tests pin it).
+    Unselected clients flow through masked to weight 0 — SPMD needs a
+    uniform program.  Returns (weighted-average params, summed metrics)."""
+
+    def body(acc, xs):
+        cdata, weight, rng = xs
+        rng, _ = jax.random.split(rng)
+        params, summed = scan_local_epochs(
+            engine, epochs, global_params, cdata, rng
+        )
+        acc_params, acc_metrics = acc
+        acc_params = jax.tree.map(
+            lambda a, p: a + p.astype(jnp.float32) * weight,
+            acc_params,
+            params,
+        )
+        selected = (weight > 0).astype(jnp.float32)
+        acc_metrics = jax.tree.map(
+            lambda a, m: a + m * selected, acc_metrics, summed
+        )
+        return (acc_params, acc_metrics), None
+
+    zero_params = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, jnp.float32), params_shape
+    )
+    zero_metrics = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), metrics_shape
+    )
+    (acc_params, metrics), _ = jax.lax.scan(
+        body, (zero_params, zero_metrics), (data, weights, rngs)
+    )
+    total = jnp.maximum(jnp.sum(weights), 1e-12)
+    new_global = jax.tree.map(
+        lambda a, g: (a / total).astype(g.dtype), acc_params, global_params
+    )
+    return new_global, metrics
+
+
 class SpmdFedAvgSession:
     """FedAvg-family rounds as single SPMD programs.
 
@@ -219,7 +294,7 @@ class SpmdFedAvgSession:
             lambda: self.engine.init_params(config.seed)
         )
         self._param_specs = {
-            k: self._leaf_spec(v.shape) for k, v in template.items()
+            k: self._leaf_spec(v.shape, k) for k, v in template.items()
         }
         self._param_shardings = {
             k: NamedSharding(self.mesh, spec)
@@ -232,7 +307,7 @@ class SpmdFedAvgSession:
 
         self._round_fn = self._build_round_fn()
 
-    def _leaf_spec(self, shape) -> P:
+    def _leaf_spec(self, shape, name: str = "") -> P:
         """FSDP layout rule: shard a param leaf's leading dim over the
         ``model`` axis when it divides evenly, else keep it replicated."""
         if self._fsdp and shape and shape[0] % self._model_axis == 0:

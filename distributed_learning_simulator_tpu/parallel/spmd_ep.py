@@ -1,0 +1,135 @@
+"""FedAvg rounds with expert-parallel MoE clients as one GSPMD program.
+
+``model_kwargs.expert_parallel: N`` gives the whole mesh to each client's
+MoE model: an ``("ep",)`` mesh shards the expert axis of the Switch-style
+feed-forward kernels (``models/moe.py`` — ``w_in``/``w_out`` stored
+``P("ep", None, None)``), clients train one after another inside the
+round program (``lax.scan``), and the weighted aggregation accumulates
+on device.  Unlike the sequence-parallel session (``spmd_sp.py``, manual
+``shard_map`` + ring collectives), expert parallelism is left to GSPMD:
+the round program is a plain ``jit`` over sharded parameters and the
+model's ``with_sharding_constraint`` annotations — XLA inserts the
+token dispatch/combine all-to-alls over ICI.  That is the TPU-native
+shape of the design: declare layouts, let the compiler place
+collectives (the reference has no model-sharding story at all,
+SURVEY.md §5).
+
+Semantics are IDENTICAL to the unsharded client-axis session — GSPMD
+partitioning preserves the math and the rng stream is the client-axis
+one (``tests/test_expert_parallel_config.py`` pins ep=4 against the
+client-axis trajectory).  Central evaluation uses the UNSHARDED engine,
+sharing the parameter structure exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..engine.engine import ComputeEngine
+from .spmd import (
+    SpmdFedAvgSession,
+    scan_weighted_clients,
+    whole_mesh_session_shapes,
+)
+
+
+class SpmdExpertParallelSession(SpmdFedAvgSession):
+    def __init__(
+        self,
+        config,
+        dataset_collection,
+        model_ctx,
+        engine: ComputeEngine,
+        practitioners,
+        expert_parallel: int,
+    ) -> None:
+        devices = jax.devices()
+        if expert_parallel > len(devices):
+            raise ValueError(
+                f"expert_parallel={expert_parallel} exceeds the "
+                f"{len(devices)}-device mesh"
+            )
+        kwargs = dict(getattr(config, "model_kwargs", {}) or {})
+        kwargs.pop("expert_parallel", None)
+        self._n_experts = int(kwargs.get("n_experts", 4))
+        if self._n_experts % expert_parallel:
+            raise ValueError(
+                f"expert_parallel={expert_parallel} must divide "
+                f"n_experts={self._n_experts}"
+            )
+        ep_mesh = Mesh(
+            np.asarray(devices[:expert_parallel]), axis_names=("ep",)
+        )
+        # the ep-mode twin: same factory, same parameter structure, forward
+        # annotated with expert-axis sharding constraints for GSPMD
+        from ..models import create_model_context
+
+        kwargs["ep_axis"] = "ep"
+        ep_model_ctx = create_model_context(
+            config.model_name, dataset_collection, **kwargs
+        )
+        ep_model_ctx.compute_dtype = model_ctx.compute_dtype
+        self._ep_engine = ComputeEngine(
+            ep_model_ctx, engine.hyper_parameter, total_steps=engine.total_steps
+        )
+        super().__init__(
+            config, dataset_collection, model_ctx, engine, practitioners,
+            mesh=ep_mesh,
+        )
+        if not any(spec != P() for spec in self._param_specs.values()):
+            raise ValueError(
+                f"expert_parallel set but model {config.model_name!r} has no "
+                "expert-stacked kernels to shard (expected an MoE model, "
+                "e.g. MoETransformerClassificationModel)"
+            )
+
+    def _leaf_spec(self, shape, name: str = "") -> P:
+        # the expert-stacked feed-forward kernels [E, d_model, d_ff] /
+        # [E, d_ff, d_model] shard their leading expert axis; everything
+        # else replicates — by declaration (moe.py), not shape heuristics
+        # (an attention out-kernel [nhead, head_dim, d_model] with
+        # nhead == n_experts must NOT match)
+        from ..models.moe import is_expert_param
+
+        leaf = jax.ShapeDtypeStruct(shape, jnp.float32)
+        if is_expert_param(name, leaf, self._n_experts):
+            return P("ep", None, None)
+        return P()
+
+    def _build_round_fn(self):
+        engine = self._ep_engine
+        epochs = self.config.epoch
+        mesh = self.mesh
+        params_shape, metrics_shape = whole_mesh_session_shapes(self)
+
+        def round_program(global_params, weights, rngs, data):
+            return scan_weighted_clients(
+                engine, epochs, global_params, data, weights, rngs,
+                params_shape, metrics_shape,
+            )
+
+        # out_shardings pin the new globals to the stored expert layout so
+        # the donated round-over-round buffers never reshard
+        jitted = jax.jit(
+            round_program,
+            donate_argnums=(0,),
+            out_shardings=(self._param_shardings, None),
+        )
+
+        def fn(global_params, weights, rngs):
+            # bare-PartitionSpec sharding constraints inside the MoE model
+            # resolve against the ambient mesh
+            with jax.sharding.set_mesh(mesh):
+                return jitted(global_params, weights, rngs, self._data)
+
+        return fn
+
+
+def build_expert_parallel_session(ctx, session_args, session_kwargs):
+    config = ctx.config
+    model_kwargs = dict(config.model_kwargs)
+    return SpmdExpertParallelSession(
+        *session_args,
+        expert_parallel=int(model_kwargs.get("expert_parallel", 0)),
+    )

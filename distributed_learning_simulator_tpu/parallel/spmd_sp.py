@@ -34,7 +34,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine.engine import ComputeEngine
 from .mesh import put_sharded
-from .spmd import SpmdFedAvgSession, scan_local_epochs, shard_map_compat
+from .spmd import (
+    SpmdFedAvgSession,
+    scan_weighted_clients,
+    shard_map_compat,
+    whole_mesh_session_shapes,
+)
 
 
 class SpmdSequenceParallelSession(SpmdFedAvgSession):
@@ -100,76 +105,23 @@ class SpmdSequenceParallelSession(SpmdFedAvgSession):
             for k, v in self._data.items()
         }
 
-    def _leaf_spec(self, shape) -> P:
+    def _leaf_spec(self, shape, name: str = "") -> P:
         return P()  # params replicated; the sequence axis is the sharded one
 
     def _build_round_fn(self):
         engine = self._sp_engine
         epochs = self.config.epoch
         mesh = self.mesh
-
-        # shape templates for the scan's running accumulator — traced with
-        # the UNSHARDED engine: the sp-mode twin needs a bound "sp" axis
-        # (its forward calls axis_index/psum) and only runs inside the
-        # round program's shard_map; param/metric STRUCTURES are identical
-        outer_engine = self.engine
-        params_shape = jax.eval_shape(
-            lambda: outer_engine.init_params(self.config.seed)
-        )
-        cdata_shape = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), self._data
-        )
-        metrics_shape = jax.eval_shape(
-            lambda gp, cd, rng: scan_local_epochs(
-                outer_engine, epochs, gp, cd, rng
-            )[1],
-            params_shape,
-            cdata_shape,
-            jax.ShapeDtypeStruct((2,), jnp.uint32),
-        )
+        params_shape, metrics_shape = whole_mesh_session_shapes(self)
 
         def round_program(global_params, weights, rngs, data):
             def shard_body(global_params, data, weights, rngs):
                 # data leaves here are LOCAL sequence blocks ([C, nb, B, L/sp]
                 # for the token input); params/weights/rngs are replicated
-
-                def body(acc, xs):
-                    cdata, weight, rng = xs
-                    # same stream as the client-axis local_train, which
-                    # reserves a quant_rng before training even when the
-                    # codec is off — the equivalence test pins this
-                    rng, _ = jax.random.split(rng)
-                    params, summed = scan_local_epochs(
-                        engine, epochs, global_params, cdata, rng
-                    )
-                    acc_params, acc_metrics = acc
-                    acc_params = jax.tree.map(
-                        lambda a, p: a + p.astype(jnp.float32) * weight,
-                        acc_params,
-                        params,
-                    )
-                    selected = (weight > 0).astype(jnp.float32)
-                    acc_metrics = jax.tree.map(
-                        lambda a, m: a + m * selected, acc_metrics, summed
-                    )
-                    return (acc_params, acc_metrics), None
-
-                zero_params = jax.tree.map(
-                    lambda s: jnp.zeros(s.shape, jnp.float32), params_shape
+                return scan_weighted_clients(
+                    engine, epochs, global_params, data, weights, rngs,
+                    params_shape, metrics_shape,
                 )
-                zero_metrics = jax.tree.map(
-                    lambda s: jnp.zeros(s.shape, s.dtype), metrics_shape
-                )
-                (acc_params, metrics), _ = jax.lax.scan(
-                    body, (zero_params, zero_metrics), (data, weights, rngs)
-                )
-                total = jnp.maximum(jnp.sum(weights), 1e-12)
-                new_global = jax.tree.map(
-                    lambda a, g: (a / total).astype(g.dtype),
-                    acc_params,
-                    global_params,
-                )
-                return new_global, metrics
 
             data_specs = jax.tree.map(
                 lambda x: P(None, None, None, "sp")

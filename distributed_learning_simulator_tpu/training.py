@@ -98,6 +98,41 @@ def _build_task(
     # ``parallel/ring_attention.py``).  Meshes can't ride YAML, so the
     # config carries the axis SIZE and the mesh is built here; the model
     # factory receives it as ``sp_mesh`` (``models/long_context.py``).
+    # ``model_kwargs.expert_parallel: N`` — shard an MoE model's expert
+    # axis over an ("ep",) mesh.  The SPMD session owns the mesh and the
+    # ep-mode twin (parallel/spmd_ep.py); the task's model_ctx stays
+    # unsharded for central evaluation.
+    model_kwargs.pop("expert_parallel", None)
+    # ``model_kwargs.pipeline_stages: S`` — GPipe the model's encoder
+    # trunk over a ("pp",) mesh of S devices (parallel/pipeline.py).  The
+    # MODEL owns the mesh (like the threaded sp_mesh mode): the config
+    # carries the stage count, the mesh is built here.
+    pipeline_stages = int(model_kwargs.get("pipeline_stages", 0))
+    if pipeline_stages and int(model_kwargs.get("sequence_parallel", 0)):
+        raise ValueError(
+            "pipeline_stages and sequence_parallel are separate sharding "
+            "layouts; set one"
+        )
+    if pipeline_stages and int(config.model_kwargs.get("expert_parallel", 0)):
+        raise ValueError(
+            "pipeline_stages and expert_parallel are separate sharding "
+            "layouts; set one"
+        )
+    if pipeline_stages > 1:
+        import jax
+        from jax.sharding import Mesh
+
+        devices = jax.devices()
+        if pipeline_stages > len(devices):
+            raise ValueError(
+                f"pipeline_stages={pipeline_stages} exceeds the "
+                f"{len(devices)}-device mesh"
+            )
+        import numpy as _np
+
+        model_kwargs["pp_mesh"] = Mesh(
+            _np.asarray(devices[:pipeline_stages]), axis_names=("pp",)
+        )
     sequence_parallel = int(model_kwargs.pop("sequence_parallel", 0))
     if sequence_parallel and resolve_executor(config) == "spmd":
         # the SPMD SP session owns the mesh (parallel/spmd_sp.py builds an
@@ -122,6 +157,16 @@ def _build_task(
     model_ctx = create_model_context(
         config.model_name, dataset_collection, **model_kwargs
     )
+    if pipeline_stages and (
+        int(getattr(model_ctx.module, "pipeline_stages", 0)) != pipeline_stages
+    ):
+        # a factory whose **kwargs swallowed the knob would train
+        # unpipelined with no signal — the same loud contract
+        # spmd_ep.py applies to expert_parallel on a non-MoE model
+        raise ValueError(
+            f"pipeline_stages set but model {config.model_name!r} does not "
+            "support a pipelined trunk (TransformerClassificationModel does)"
+        )
     if config.use_amp:
         # reference use_amp (torch autocast) → bfloat16 compute on the MXU:
         # params/optimizer state stay float32, forward+backward run bf16
@@ -400,6 +445,27 @@ def resolve_executor(config) -> str:
         raise ValueError(
             f"unknown executor {executor!r}; expected one of {_EXECUTORS}"
         )
+    if int(dict(config.model_kwargs).get("expert_parallel", 0)):
+        if config.distributed_algorithm != "fed_avg":
+            raise ValueError(
+                "expert_parallel is implemented for fed_avg "
+                "(parallel/spmd_ep.py: the SPMD session gives the ep mesh "
+                "to each client's MoE model); drop the key for "
+                f"{config.distributed_algorithm!r}"
+            )
+        if executor == "sequential":
+            raise ValueError(
+                "expert_parallel requires the SPMD executor (GSPMD shards "
+                "the expert kernels); drop executor=sequential"
+            )
+        return "spmd"
+    if int(dict(config.model_kwargs).get("pipeline_stages", 0)) > 1:
+        if executor == "spmd":
+            raise ValueError(
+                "pipeline_stages runs on the threaded executor (the model "
+                "owns the pp mesh, models/text.py); drop executor=spmd"
+            )
+        return "sequential"
     if executor != "auto":
         return executor
     if int(dict(config.model_kwargs).get("sequence_parallel", 0)):
@@ -426,6 +492,24 @@ def resolve_executor(config) -> str:
 
 
 def _make_spmd_session(ctx: TaskContext):
+    model_kwargs = dict(ctx.config.model_kwargs)
+    if int(model_kwargs.get("expert_parallel", 0)):
+        if int(model_kwargs.get("sequence_parallel", 0)):
+            raise ValueError(
+                "expert_parallel and sequence_parallel are separate session "
+                "layouts; set one (composing them is a mesh design choice "
+                "the YAML surface does not expose)"
+            )
+        from .parallel.spmd_ep import build_expert_parallel_session
+
+        session_args = (
+            ctx.config,
+            ctx.dataset_collection,
+            ctx.model_ctx,
+            ctx.engine,
+            ctx.practitioners,
+        )
+        return build_expert_parallel_session(ctx, session_args, {})
     if int(dict(ctx.config.model_kwargs).get("sequence_parallel", 0)):
         if ctx.config.distributed_algorithm != "fed_avg":
             raise ValueError(
