@@ -78,16 +78,20 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, kv_mask=None):
     local attention output ``[B, T_local, H, D]``.
 
     When the per-device block is eligible for the fused Pallas kernel
-    (``ops/fused_attention.kernel_tier``; non-causal — causal cross-block
-    offsets stay on the jnp path) each hop's block attention runs as one
-    kernel call and hops merge differentiable ``(out, lse)`` pairs — the
-    composition that makes multi-chip long context ride the same kernel
+    (``ops/fused_attention.kernel_tier``) each hop's block attention runs
+    as one kernel call and hops merge differentiable ``(out, lse)`` pairs —
+    the composition that makes multi-chip long context ride the same kernel
     as single-chip (the lse cotangent folds into the kernel's backward).
+    Causal rides the kernel too: with equal-size blocks in axis-index
+    order, hop 0 is the diagonal block (the kernel's own causal mask,
+    global row/col offsets are equal) and every later hop is either fully
+    visible or fully masked — never diagonal — so visibility is a per-hop
+    lse select, not a kernel concern.
     """
     from ..ops.fused_attention import kernel_tier
 
-    if not causal and kernel_tier(q.shape[1], q.shape[3], q.dtype.itemsize):
-        return _ring_attention_fused(q, k, v, axis_name, kv_mask)
+    if kernel_tier(q.shape[1], q.shape[3], q.dtype.itemsize):
+        return _ring_attention_fused(q, k, v, axis_name, kv_mask, causal)
     axis_size = jax.lax.psum(1, axis_name)
     my_index = jax.lax.axis_index(axis_name)
     batch, t_local, heads, dim = q.shape
@@ -152,33 +156,47 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, kv_mask=None):
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
-def _ring_attention_fused(q, k, v, axis_name: str, kv_mask):
+def _ring_attention_fused(q, k, v, axis_name: str, kv_mask, causal=False):
     """Ring hops over Pallas-fused block attention.  Each hop computes its
     K/V block's partial ``(out, lse)`` with ``fused_attention_lse`` and the
     carry merges the pairs with the standard log-sum-exp combination —
     numerically identical to the online-softmax recurrence, and
-    differentiable end-to-end (scan over custom_vjp calls + ppermute)."""
+    differentiable end-to-end (scan over custom_vjp calls + ppermute).
+
+    Causal: hop 0 (the local block) is the only diagonal — the kernel's
+    causal mask applies as-is.  At hop ``h``, the arriving K/V block is
+    ``kv_index = my_index - h (mod N)``: fully visible when
+    ``my_index >= h`` (all its key positions precede the local queries),
+    fully masked otherwise — encoded by forcing that hop's ``lse`` to
+    -inf, which zeroes its merge weight.  Devices early in the ring
+    compute hops they discard (the uniform-program bubble every
+    non-striped ring layout pays; the jnp path pays it as a full masked
+    score block instead)."""
     from ..ops.fused_attention import fused_attention_lse
 
     axis_size = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
     batch, t_local, _, _ = q.shape
     mask0 = (
         jnp.ones((batch, t_local), jnp.float32)
         if kv_mask is None
         else kv_mask.astype(jnp.float32)
     )
-    o, lse = fused_attention_lse(q, k, v, kv_mask=mask0 != 0)
+    o, lse = fused_attention_lse(q, k, v, kv_mask=mask0 != 0, causal=causal)
     o = o.astype(jnp.float32)
 
     if axis_size > 1:
         perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
-        def step(carry, _):
+        def step(carry, hop):
             o, lse, k_blk, v_blk, m_blk = carry
             k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
             v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
             m_blk = jax.lax.ppermute(m_blk, axis_name, perm)
             o_b, lse_b = fused_attention_lse(q, k_blk, v_blk, kv_mask=m_blk != 0)
+            if causal:
+                visible = (my_index >= hop)[None, None, None]
+                lse_b = jnp.where(visible, lse_b, _NEG_INF)
             m = jnp.maximum(lse, lse_b)  # [B, H, T]
             w = jnp.exp(lse - m)
             w_b = jnp.exp(lse_b - m)
@@ -191,7 +209,7 @@ def _ring_attention_fused(q, k, v, axis_name: str, kv_mask):
             return (o, lse, k_blk, v_blk, m_blk), None
 
         (o, lse, _k, _v, _m), _ = jax.lax.scan(
-            step, (o, lse, k, v, mask0), None, length=axis_size - 1
+            step, (o, lse, k, v, mask0), jnp.arange(1, axis_size)
         )
     return o.astype(q.dtype)
 

@@ -108,6 +108,11 @@ def _build_task(
     # MODEL owns the mesh (like the threaded sp_mesh mode): the config
     # carries the stage count, the mesh is built here.
     pipeline_stages = int(model_kwargs.get("pipeline_stages", 0))
+    if int(model_kwargs.get("pipeline_microbatches", 0)) and not pipeline_stages:
+        raise ValueError(
+            "pipeline_microbatches without pipeline_stages is inert; set "
+            "pipeline_stages (1 = stacked trunk, sequential) or drop it"
+        )
     if pipeline_stages and int(model_kwargs.get("sequence_parallel", 0)):
         raise ValueError(
             "pipeline_stages and sequence_parallel are separate sharding "

@@ -152,9 +152,10 @@ def test_fused_ring_grad_matches_dense(monkeypatch):
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
 def test_fused_kernel_branch_causal(impl, monkeypatch):
     """Causal + kernel branch: Ulysses runs causal THROUGH the kernel
-    (positions are global after the all-to-all); ring's kernel branch is
-    gated to non-causal, so causal must still produce the exact dense
-    result via its jnp path."""
+    (positions are global after the all-to-all); ring runs hop 0 with the
+    kernel's causal mask and later hops non-causal with a visibility lse
+    select (ring_attention.py::_ring_attention_fused) — both must produce
+    the exact dense result."""
     monkeypatch.setenv("DLS_TPU_FUSED_ATTN", "interpret")
     q, k, v = _qkv(6)
     mesh = _mesh()
@@ -163,3 +164,42 @@ def test_fused_kernel_branch_causal(impl, monkeypatch):
     )(q, k, v)
     ref = dense_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_fused_ring_causal_with_padding(monkeypatch):
+    """Causal AND key-padding simultaneously through the kernel ring."""
+    monkeypatch.setenv("DLS_TPU_FUSED_ATTN", "interpret")
+    q, k, v = _qkv(7)
+    kv_mask = jnp.asarray(np.random.RandomState(8).rand(B, T) > 0.3, bool)
+    kv_mask = kv_mask.at[:, 0].set(True)  # row 0 attends to itself at least
+    mesh = _mesh()
+    out = jax.jit(
+        lambda q, k, v, m: sharded_attention(
+            q, k, v, mesh, impl="ring", causal=True, kv_mask=m
+        )
+    )(q, k, v, kv_mask)
+    ref = dense_attention(q, k, v, causal=True, kv_mask=kv_mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_fused_ring_causal_grad_matches_dense(monkeypatch):
+    """Causal gradients through the kernel-per-hop ring: the visibility
+    select on lse must not leak cotangent into invisible hops."""
+    monkeypatch.setenv("DLS_TPU_FUSED_ATTN", "interpret")
+    q, k, v = _qkv(9)
+    mesh = _mesh()
+    sharding = NamedSharding(mesh, P(None, "sp"))
+    fn = make_sequence_parallel_attention(mesh, impl="ring", causal=True)
+
+    def loss_sp(q, k, v):
+        return jnp.sum(jnp.sin(fn(q, k, v)))
+
+    def loss_dense(q, k, v):
+        return jnp.sum(jnp.sin(dense_attention(q, k, v, causal=True)))
+
+    got = jax.grad(loss_sp, argnums=(0, 1, 2))(
+        *(jax.device_put(x, sharding) for x in (q, k, v))
+    )
+    want = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=2e-5)
