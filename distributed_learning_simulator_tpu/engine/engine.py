@@ -72,8 +72,20 @@ class ComputeEngine:
         (loss, aux), grads = self.loss_and_grad(params, batch, rng)
         if self.grad_sync_axis:
             grads = jax.lax.pmean(grads, self.grad_sync_axis)
-        updates, opt_state = self.optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        updates, new_opt_state = self.optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        # an all-padding batch (SPMD slot padding: shorter clients share the
+        # longest client's batch count) must be a TRUE no-op — zero grads
+        # still decay the momentum trace and advance the schedule count,
+        # which the threaded executor (which never sees these batches)
+        # would not do.  Cross-executor trajectory parity pins this.
+        nonempty = aux["count"] > 0
+        params = jax.tree.map(
+            lambda n, o: jnp.where(nonempty, n, o), new_params, params
+        )
+        opt_state = jax.tree.map(
+            lambda n, o: jnp.where(nonempty, n, o), new_opt_state, opt_state
+        )
         metrics = {
             "loss": loss,
             "correct": aux["correct"],

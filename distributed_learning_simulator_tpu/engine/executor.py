@@ -32,6 +32,19 @@ _PER_STEP_POINTS = (
 )
 
 
+def aligned_round_stream(seed: int, round_number: int, worker_id: int):
+    """The SPMD executor's per-(round, client) rng, reproduced exactly
+    (``parallel/spmd.py`` run loop: a split chain from ``PRNGKey(seed)``
+    yields each round's rng; ``fold_in(round_rng, worker_id)`` yields the
+    client stream).  The threaded executor feeds this to
+    :meth:`Trainer.set_round_stream` so both executors train identical
+    fed_avg trajectories (``tests/test_executor_matrix.py`` pins it)."""
+    rng = jax.random.PRNGKey(seed)
+    for _ in range(round_number):
+        rng, round_rng = jax.random.split(rng)
+    return jax.random.fold_in(round_rng, worker_id)
+
+
 class PerformanceMetric:
     def __init__(self) -> None:
         self.epoch_metrics: dict[int, dict[str, float]] = {}
@@ -147,7 +160,18 @@ class Trainer(ExecutorBase):
         self._opt_state = None
         self._rng = jax.random.PRNGKey(self._seed + 0x5EED)
         self._epoch_counter = 0  # cumulative epochs across rounds
+        self._round_stream = None  # SPMD-aligned rng for the next round
         self.batch_loss_log_enabled = True
+
+    def set_round_stream(self, rng) -> None:
+        """Arm the next ``train()`` call with an SPMD-aligned rng stream
+        (:func:`aligned_round_stream`): epoch rngs split exactly like
+        ``scan_local_epochs`` (a quant rng is reserved first, matching
+        ``local_train``), and per-epoch shuffling is disabled — the SPMD
+        path trains the stacked sampler-order batches every epoch, and
+        batch parity is part of trajectory parity.  One-shot: cleared when
+        consumed."""
+        self._round_stream = rng
 
     # --- hook API (reference Trainer.append_named_hook/remove_hook/...) ---
     def append_named_hook(
@@ -201,14 +225,25 @@ class Trainer(ExecutorBase):
         hp = self.hyper_parameter
         self._fire(ExecutorHookPoint.BEFORE_EXECUTE)
         per_step = any(self.has_hook(p) for p in _PER_STEP_POINTS)
+        aligned, self._round_stream = self._round_stream, None
+        if aligned is not None:
+            train_rng, _quant = jax.random.split(aligned)
+            aligned_epoch_rngs = jax.random.split(train_rng, hp.epoch)
         try:
             for epoch in range(1, hp.epoch + 1):
                 start = time.monotonic()
                 self._epoch_counter += 1
-                shuffle_seed = self._seed * 100003 + self._epoch_counter
+                shuffle_seed = (
+                    None
+                    if aligned is not None
+                    else self._seed * 100003 + self._epoch_counter
+                )
                 batches = self._epoch_batches(self.phase, shuffle_seed)
                 self._fire(ExecutorHookPoint.BEFORE_EPOCH, epoch=epoch)
-                self._rng, epoch_rng = jax.random.split(self._rng)
+                if aligned is not None:
+                    epoch_rng = aligned_epoch_rngs[epoch - 1]
+                else:
+                    self._rng, epoch_rng = jax.random.split(self._rng)
                 # graph minibatch epochs stack batch-invariant leaves as
                 # zero-copy broadcast VIEWS; the jitted scan would transfer
                 # them densely (graph × batch_number on device), so step

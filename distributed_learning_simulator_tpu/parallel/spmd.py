@@ -590,8 +590,19 @@ class SpmdFedAvgSession:
                 host_weights = self._select_weights(round_number)
                 weights = put_sharded(host_weights, self._client_sharding)
                 rng, round_rng = jax.random.split(rng)
+                # per-client streams by fold_in, NOT split(round_rng, n):
+                # fold_in is indexed by WORKER ID alone, so the stream is
+                # independent of slot padding / device count — the threaded
+                # executor derives the identical stream per worker
+                # (engine/executor.py::aligned_round_stream) and the
+                # cross-executor parity test pins fed_avg trajectories
                 client_rngs = put_sharded(
-                    jax.random.split(round_rng, self.n_slots), self._client_sharding
+                    np.asarray(
+                        jax.vmap(lambda i: jax.random.fold_in(round_rng, i))(
+                            jnp.arange(self.n_slots)
+                        )
+                    ),
+                    self._client_sharding,
                 )
                 # old global_params are donated into the round program —
                 # any pending background fetch of them must finish first

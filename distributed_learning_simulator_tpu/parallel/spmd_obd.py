@@ -320,6 +320,11 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         and schedule position exactly (the SURVEY §5 TPU plan's
         'per-client opt state' checkpoint)."""
         leaves = jax.tree.leaves(self._opt_state_s)
+        if jax.process_count() > 1:
+            # the [S, ...] states are client-sharded across hosts; the
+            # async writer can only fetch addressable arrays — reshard to
+            # replicated first (same dance as _checkpointable)
+            leaves = [jax.device_put(leaf, self._replicated) for leaf in leaves]
         payload = {f"leaf_{i}": leaf for i, leaf in enumerate(leaves)}
         payload["stat_key"] = np.int64(stat_key)
         self._ckpt.save_npz(

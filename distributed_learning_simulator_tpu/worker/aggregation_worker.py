@@ -81,6 +81,24 @@ class AggregationWorker(Client):
                 return
         self._register_aggregation()
 
+    def _before_round(self) -> None:
+        """fed_avg trains the SPMD executor's exact rng stream
+        (``aligned_round_stream``), pinning cross-executor trajectory
+        parity (VERDICT r3 item 4).  Other methods keep the legacy
+        per-worker stream: their extra rng consumers sit in different
+        places on the two executors (endpoint codecs vs in-program QSGD,
+        per-step exchanges, OBD phase logic), so stream alignment alone
+        cannot make them bit-comparable — see PARITY.md."""
+        super()._before_round()
+        if self.config.distributed_algorithm == "fed_avg":
+            from ..engine.executor import aligned_round_stream
+
+            self.trainer.set_round_stream(
+                aligned_round_stream(
+                    self.config.seed, self._round_num, self.worker_id
+                )
+            )
+
     def _register_aggregation(self) -> None:
         self.trainer.remove_named_hook(name="aggregation")
 

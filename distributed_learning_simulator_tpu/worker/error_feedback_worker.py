@@ -4,13 +4,21 @@ TPU-native equivalent of
 ``simulation_lib/worker/error_feedback_worker.py:9-19``: keeps a residual
 ``_error`` parameter dict, ships ``sparsify(delta + error)`` and folds the
 truncation error back into the residual.  Basis of the ``single_model_afd``
-method family.
+method family.  The residual is persisted per round
+(``worker_N/error_feedback.npz``) and restored from
+``algorithm_kwargs.resume_dir`` so a resumed run continues the exact
+error-feedback dynamics (the reference keeps it in-memory only and loses
+it on restart).
 """
 
+import os
 from typing import Any
+
+import numpy as np
 
 from ..message import DeltaParameterMessage, ParameterMessageBase
 from ..ops.pytree import Params
+from ..utils.logging import get_logger
 from .aggregation_worker import AggregationWorker
 
 
@@ -24,6 +32,27 @@ class ErrorFeedbackWorker(AggregationWorker):
         """Subclass hook: return the (sparse) payload actually sent."""
         raise NotImplementedError
 
+    def _before_training(self) -> None:
+        resume_dir = self.config.algorithm_kwargs.get("resume_dir")
+        if resume_dir:
+            path = os.path.join(
+                str(resume_dir),
+                os.path.basename(self.save_dir),
+                "error_feedback.npz",
+            )
+            if os.path.isfile(path):
+                with np.load(path) as blob:
+                    self._error = {k: blob[k] for k in blob.files}
+                get_logger().info(
+                    "%s: restored error-feedback residual", self.name
+                )
+            else:
+                get_logger().warning(
+                    "%s: resume without error_feedback.npz — residual "
+                    "restarts at zero", self.name
+                )
+        super()._before_training()
+
     def _get_sent_data(self) -> ParameterMessageBase:
         message = super()._get_sent_data()
         assert isinstance(message, DeltaParameterMessage)
@@ -32,5 +61,9 @@ class ErrorFeedbackWorker(AggregationWorker):
             delta = {k: v + self._error.get(k, 0.0) for k, v in delta.items()}
         sent = self._sparsify(delta)
         self._error = {k: delta[k] - sent.get(k, 0.0) for k in delta}
+        np.savez(
+            os.path.join(self.save_dir, "error_feedback.npz"),
+            **{k: np.asarray(v) for k, v in self._error.items()},
+        )
         message.delta_parameter = sent
         return message

@@ -23,6 +23,16 @@ def _counts(opt_state) -> list[int]:
     ]
 
 
+def _real_batches(session) -> np.ndarray:
+    """Per-slot count of NONEMPTY batches: all-padding batches (shorter
+    clients share the longest client's batch count; zero-weight padding
+    slots are all padding) are true no-ops in the engine — they advance
+    neither momentum nor the schedule (cross-executor parity,
+    ``engine/engine.py::train_step_fn``)."""
+    sizes = np.asarray(session._dataset_sizes)
+    return np.ceil(sizes / session.config.batch_size).astype(np.int32)
+
+
 def _make_session(tmp_session_dir, rounds: int, phase2_epochs: int):
     config = fed_avg_config(
         distributed_algorithm="fed_obd",
@@ -59,14 +69,13 @@ def test_phase2_schedule_position_continues(tmp_session_dir):
     result = session.run()
     assert result["performance"]
 
-    steps_per_epoch = session.n_batches
     counts = _counts(session._opt_state_s)
     assert counts, "optimizer state has no schedule count leaf"
-    # phase 1: 1 round x 1 epoch = steps_per_epoch steps (optimizer rebuilt
-    # per round); phase 2: 3 epochs CONTINUE the same state -> final count
-    # = (1 + 3) x steps_per_epoch on every slot.  A phase-2 restart (the
-    # retired deviation) would leave 1 x steps_per_epoch.
-    expected = (1 + phase2_epochs) * steps_per_epoch
+    # phase 1: 1 round x 1 epoch of each slot's REAL batches (optimizer
+    # rebuilt per round); phase 2: 3 epochs CONTINUE the same state ->
+    # final count = (1 + 3) x real_batches per slot.  A phase-2 restart
+    # (the retired deviation) would leave 1 x real_batches.
+    expected = (1 + phase2_epochs) * _real_batches(session)
     for count in counts:
         assert np.all(count == expected), (count, expected)
 
@@ -101,7 +110,8 @@ def test_phase2_momentum_carries_across_switch(tmp_session_dir):
     entry = captured["entry"]
     assert entry is not None, "phase 2 was invoked without a carried state"
     counts = _counts(entry)
-    assert counts and all(np.all(c > 0) for c in counts)
+    real = _real_batches(session) > 0  # padding slots never step
+    assert counts and all(np.all(c[real] > 0) for c in counts)
     traces = [
         np.asarray(leaf)
         for leaf in jax.tree.leaves(entry)
@@ -157,7 +167,7 @@ def test_phase2_resume_restores_optimizer_states(tmp_session_dir):
     the restored value instead of restarting."""
     session, ctx = _make_session(tmp_session_dir, rounds=1, phase2_epochs=1)
     session.run()
-    steps = session.n_batches
+    steps = _real_batches(session)
     # 1 phase-1 round + 1 phase-2 epoch, states saved tagged with the final
     # aggregate (key 2)
     final_counts = _counts(session._opt_state_s)
