@@ -124,7 +124,16 @@ class SpmdSMAFDSession(SpmdFedAvgSession):
 
     def _record(self, round_number, metric, global_params, save_dir, extra=None):
         super()._record(round_number, metric, global_params, save_dir, extra)
-        payload = dict(self._err_state)
+        err_state = self._err_state
+        if jax.process_count() > 1:
+            # P("clients")-sharded residuals are non-addressable on a pod;
+            # the async writer needs replicated arrays (same dance as
+            # spmd_obd._save_opt_state)
+            err_state = {
+                k: jax.device_put(v, self._replicated)
+                for k, v in err_state.items()
+            }
+        payload = dict(err_state)
         payload["__round__"] = np.int64(round_number)
         self._ckpt.save_npz(self._err_path(self.config.save_dir), payload)
 

@@ -27,6 +27,32 @@ def load_resume_state(
     round-0 init row when present).  ``(None, {}, 0)`` when nothing
     resumable exists.
     """
+    last = resumable_round(resume_dir)
+    if last == 0:
+        return None, {}, 0
+    model_dir = os.path.join(resume_dir, "aggregated_model")
+    with np.load(os.path.join(model_dir, f"round_{last}.npz")) as blob:
+        params = {k: blob[k] for k in blob.files}
+    recorded = _recorded_stats(resume_dir)
+    stats = {k: v for k, v in recorded.items() if k <= last}
+    return params, stats, last
+
+
+def _recorded_stats(resume_dir: str) -> dict[int, dict]:
+    record_path = os.path.join(resume_dir, "server", "round_record.json")
+    if not os.path.isfile(record_path):
+        return {}
+    with open(record_path, encoding="utf8") as f:
+        return {int(k): v for k, v in json.load(f).items()}
+
+
+def resumable_round(resume_dir: str) -> int:
+    """The round ``load_resume_state`` resumes from, without loading the
+    checkpoint itself (0 when nothing is resumable): the latest round with
+    BOTH a ``round_N.npz`` checkpoint and a record row.  Workers use this
+    to validate that per-worker side state (e.g. the error-feedback
+    residual) was not written in a later, never-checkpointed round.
+    """
     model_dir = os.path.join(resume_dir, "aggregated_model")
     rounds = (
         sorted(
@@ -37,19 +63,9 @@ def load_resume_state(
         if os.path.isdir(model_dir)
         else []
     )
-    recorded: dict[int, dict] = {}
-    record_path = os.path.join(resume_dir, "server", "round_record.json")
-    if os.path.isfile(record_path):
-        with open(record_path, encoding="utf8") as f:
-            recorded = {int(k): v for k, v in json.load(f).items()}
+    recorded = _recorded_stats(resume_dir)
     rounds = [n for n in rounds if n in recorded]
-    if not rounds:
-        return None, {}, 0
-    last = rounds[-1]
-    with np.load(os.path.join(model_dir, f"round_{last}.npz")) as blob:
-        params = {k: blob[k] for k in blob.files}
-    stats = {k: v for k, v in recorded.items() if k <= last}
-    return params, stats, last
+    return rounds[-1] if rounds else 0
 
 
 def load_round_checkpoint(resume_dir: str, round_number: int) -> dict | None:
@@ -64,4 +80,4 @@ def load_round_checkpoint(resume_dir: str, round_number: int) -> dict | None:
         return {k: blob[k] for k in blob.files}
 
 
-__all__ = ["load_resume_state", "load_round_checkpoint"]
+__all__ = ["load_resume_state", "load_round_checkpoint", "resumable_round"]

@@ -40,18 +40,51 @@ class ErrorFeedbackWorker(AggregationWorker):
                 os.path.basename(self.save_dir),
                 "error_feedback.npz",
             )
-            if os.path.isfile(path):
-                with np.load(path) as blob:
-                    self._error = {k: blob[k] for k in blob.files}
+            restored = self._load_residual(path, str(resume_dir))
+            if restored is not None:
+                self._error = restored
                 get_logger().info(
                     "%s: restored error-feedback residual", self.name
                 )
             else:
                 get_logger().warning(
-                    "%s: resume without error_feedback.npz — residual "
-                    "restarts at zero", self.name
+                    "%s: resume without a usable error_feedback.npz — "
+                    "residual restarts at zero", self.name
                 )
         super()._before_training()
+
+    def _load_residual(self, path: str, resume_dir: str) -> Params | None:
+        """Load a round-tagged residual, or None when missing/corrupt/stale.
+
+        The residual written during a round the server never checkpointed
+        is ahead of the restored params — reusing it would apply a
+        mismatched correction, so a ``__round__`` tag greater than the
+        server's resumable round is rejected.  An OLDER tag is fine: with
+        client selection an unselected worker keeps (and does not rewrite)
+        the residual from its last participating round, which is exactly
+        the state an uninterrupted run would carry forward.
+        """
+        if not os.path.isfile(path):
+            return None
+        from ..util.resume import resumable_round
+
+        try:
+            with np.load(path) as blob:
+                data = {k: blob[k] for k in blob.files}
+        except Exception as exc:  # corrupt/truncated file
+            get_logger().warning(
+                "%s: error_feedback.npz unreadable (%s)", self.name, exc
+            )
+            return None
+        tag = data.pop("__round__", None)
+        server_round = resumable_round(resume_dir)
+        if tag is None or int(tag) > server_round:
+            get_logger().warning(
+                "%s: residual round tag %s is ahead of resumable "
+                "round %d", self.name, tag, server_round
+            )
+            return None
+        return data
 
     def _get_sent_data(self) -> ParameterMessageBase:
         message = super()._get_sent_data()
@@ -61,9 +94,14 @@ class ErrorFeedbackWorker(AggregationWorker):
             delta = {k: v + self._error.get(k, 0.0) for k, v in delta.items()}
         sent = self._sparsify(delta)
         self._error = {k: delta[k] - sent.get(k, 0.0) for k in delta}
+        final = os.path.join(self.save_dir, "error_feedback.npz")
+        # .npz suffix keeps np.savez from appending one to the tmp name
+        tmp = os.path.join(self.save_dir, "error_feedback.tmp.npz")
         np.savez(
-            os.path.join(self.save_dir, "error_feedback.npz"),
+            tmp,
+            __round__=np.asarray(self._round_num),
             **{k: np.asarray(v) for k, v in self._error.items()},
         )
+        os.replace(tmp, final)
         message.delta_parameter = sent
         return message

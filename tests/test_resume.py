@@ -410,3 +410,74 @@ def test_spmd_shapley_resume(tmp_session_dir):
     )
     with open(os.path.join(resumed.save_dir, "shapley_values.json")) as f:
         assert set(json.load(f)) == {"1", "2", "3", "4"}
+
+
+def test_error_feedback_residual_round_tag(tmp_session_dir, tmp_path):
+    """The threaded error-feedback residual is written atomically with a
+    ``__round__`` tag and validated on restore: a tag at-or-behind the
+    server's resumable round is accepted (unselected workers keep older
+    residuals), a tag ahead of it (written in a round the server never
+    checkpointed) or a corrupt file degrades to the zero-restart warning
+    instead of crashing the resume."""
+    import json as _json
+
+    import numpy as np
+
+    from distributed_learning_simulator_tpu.worker.error_feedback_worker import (
+        ErrorFeedbackWorker,
+    )
+
+    # an e2e threaded run leaves a tagged residual and no tmp leftover
+    config = _config(
+        distributed_algorithm="single_model_afd",
+        executor="sequential",
+        worker_number=2,
+        round=2,
+        algorithm_kwargs={"dropout_rate": 0.3},
+    )
+    config.load_config_and_process()
+    train(config)
+    worker_dir = os.path.join(config.save_dir, "worker_0")
+    residual_path = os.path.join(worker_dir, "error_feedback.npz")
+    assert os.path.isfile(residual_path)
+    assert not os.path.isfile(
+        os.path.join(worker_dir, "error_feedback.tmp.npz")
+    )
+    with np.load(residual_path) as blob:
+        assert int(blob["__round__"]) == 2
+
+    # unit-level tag matrix against a synthetic server checkpoint layout
+    resume_dir = tmp_path / "session"
+    (resume_dir / "aggregated_model").mkdir(parents=True)
+    (resume_dir / "server").mkdir()
+    np.savez(resume_dir / "aggregated_model" / "round_2.npz", w=np.ones(3))
+    with open(resume_dir / "server" / "round_record.json", "w") as f:
+        _json.dump({"1": {}, "2": {}}, f)
+
+    class _Stub:
+        name = "worker_0"
+
+    load = ErrorFeedbackWorker._load_residual
+
+    def residual_with_tag(tag):
+        path = tmp_path / "error_feedback.npz"
+        np.savez(path, __round__=np.asarray(tag), w=np.full(3, 0.5))
+        return str(path)
+
+    # tag == resumable round: accepted
+    ok = load(_Stub(), residual_with_tag(2), str(resume_dir))
+    assert ok is not None and "__round__" not in ok
+    # tag behind (worker unselected in round 2): still accepted
+    assert load(_Stub(), residual_with_tag(1), str(resume_dir)) is not None
+    # tag ahead (round 3 never checkpointed): rejected
+    assert load(_Stub(), residual_with_tag(3), str(resume_dir)) is None
+    # untagged legacy file: rejected
+    legacy = tmp_path / "legacy.npz"
+    np.savez(legacy, w=np.ones(3))
+    assert load(_Stub(), str(legacy), str(resume_dir)) is None
+    # corrupt file: warning, not a crash
+    corrupt = tmp_path / "corrupt.npz"
+    corrupt.write_bytes(b"not a zipfile")
+    assert load(_Stub(), str(corrupt), str(resume_dir)) is None
+    # missing file
+    assert load(_Stub(), str(tmp_path / "absent.npz"), str(resume_dir)) is None
