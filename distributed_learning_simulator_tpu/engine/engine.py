@@ -30,6 +30,7 @@ class ComputeEngine:
         hyper_parameter: HyperParameter,
         total_steps: int,
         grad_sync_axis: str = "",
+        grad_sync_fn: Any = None,
     ) -> None:
         self.model_ctx = model_ctx
         self.hyper_parameter = hyper_parameter
@@ -38,8 +39,13 @@ class ComputeEngine:
         # compute (sequence parallelism: each device computes a partial
         # backward), gradients must be reduced over that axis before the
         # optimizer update — pmean here, with the model's pooling boundary
-        # making pmean uniformly correct (parallel/collectives.py)
+        # making pmean uniformly correct (parallel/collectives.py).
+        # ``grad_sync_fn`` overrides with a per-leaf rule for layouts
+        # where no uniform reduction is right (pipeline parallelism:
+        # stage-sharded trunk leaves stay local, replicated leaves pmean
+        # — parallel/spmd_pp.py derives why)
         self.grad_sync_axis = grad_sync_axis
+        self.grad_sync_fn = grad_sync_fn
         self.optimizer = hyper_parameter.make_optimizer(self.total_steps)
         self.schedule = hyper_parameter.make_schedule(self.total_steps)
         # rematerialization for large client models (ViT/BERT-scale):
@@ -70,7 +76,9 @@ class ComputeEngine:
 
     def train_step_fn(self, params, opt_state, batch, rng):
         (loss, aux), grads = self.loss_and_grad(params, batch, rng)
-        if self.grad_sync_axis:
+        if self.grad_sync_fn is not None:
+            grads = self.grad_sync_fn(grads)
+        elif self.grad_sync_axis:
             grads = jax.lax.pmean(grads, self.grad_sync_axis)
         updates, new_opt_state = self.optimizer.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)

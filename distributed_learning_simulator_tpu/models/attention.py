@@ -1,0 +1,112 @@
+"""Layout-clean multi-head self-attention (shared by the ViT / text zoos).
+
+``flax.linen.MultiHeadDotProductAttention`` keeps heads in the third axis
+of ``[B, S, H, Dh]`` tensors and einsums with the head axis in the middle
+(``...qhd,...khd->...hqk``); on TPU, XLA must insert layout-conversion
+copies around every one of those einsums — profiled at **17% of the
+ViT-small federated round** (119 ms of ``copy`` ops out of a 684 ms round
+on the v5e; BASELINE.md round-5 trace analysis).  It also projects Q, K
+and V with three separate matmuls whose ``N = d_model`` is below the MXU
+sweet spot.
+
+This module removes both costs:
+
+* **one fused QKV projection** — a single ``[B*S, D] @ [D, 3D]`` matmul;
+* tensors are transposed ONCE into the ``[B, H, S, Dh]`` batched-matmul
+  layout and stay there through ``QK^T``, softmax, and ``PV`` (leading
+  batch dims ⇒ clean batched matmuls, no per-einsum layout flips).
+
+Long sequences route to the Pallas fused-attention kernel
+(``ops/fused_attention.py``) exactly like the flax ``attention_fn`` hook
+did — same eligibility gate, same kernel.
+
+Reference parity: the reference's transformer blocks use torch
+``nn.MultiheadAttention`` (models from ``cyy_torch_text`` /
+``cyy_huggingface_toolbox``, SURVEY.md §2.13), which also computes QKV as
+one packed ``in_proj`` matmul — this is the TPU-native equivalent, not a
+behavioural change (softmax in f32, scaling by ``Dh^-0.5``).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class FusedSelfAttention(nn.Module):
+    """Multi-head self-attention with a packed QKV projection.
+
+    ``mask``, when given, is a flax-style key-padding mask broadcastable
+    to ``[B, H, S_q, S_k]`` with True = attend (the zoo passes
+    ``[B, 1, 1, S]``).  Dropout (when ``train`` and ``dropout_rate > 0``)
+    is applied to the attention probabilities, matching
+    ``MultiHeadDotProductAttention``'s placement.
+    """
+
+    num_heads: int
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, mask=None, train: bool = False):
+        from ..ops import fused_attention as fa
+        from ..ops import short_attention as sa
+
+        d = x.shape[-1]
+        h = self.num_heads
+        assert d % h == 0, f"d_model {d} not divisible by {h} heads"
+        dh = d // h
+        b, s = x.shape[0], x.shape[1]
+
+        qkv = nn.Dense(3 * d, name="qkv")(x)
+
+        drop_active = self.dropout_rate > 0.0 and train
+        if not drop_active and sa.short_eligible(
+            s, d, h, x.dtype.itemsize
+        ):
+            # short-sequence Pallas kernel: consumes the packed projection
+            # in place — no head split/transpose ever reaches HBM
+            kv_mask = None
+            if mask is not None:
+                kv_mask = jnp.broadcast_to(mask, (b, 1, 1, s))[:, 0, 0, :]
+            out = sa.short_attention(qkv, h, kv_mask=kv_mask)
+            return nn.Dense(d, name="out")(out)
+
+        q, k, v = (
+            t.reshape(b, s, h, dh) for t in jnp.split(qkv, 3, axis=-1)
+        )
+        if not drop_active and fa.eligible(q, None, 0.0, True):
+            # long-sequence path: the Pallas kernel wants [B, S, H, Dh]
+            # and applies the Dh^-0.5 scale itself
+            kv_mask = None
+            if mask is not None:
+                kv_mask = jnp.broadcast_to(
+                    mask, (b, 1, 1, s)
+                )[:, 0, 0, :]
+            out = fa.fused_attention(q, k, v, kv_mask=kv_mask).reshape(
+                b, s, d
+            )
+        else:
+            # batch dims (B, H) expressed IN PLACE (dims 0, 2) — no user
+            # transposes; XLA folds the layout into the matmul
+            dn = (((3,), (3,)), ((0, 2), (0, 2)))
+            logits = jax.lax.dot_general(
+                q * (dh**-0.5), k, dn
+            )  # [B, H, S_q, S_k]
+            if mask is not None:
+                logits = jnp.where(
+                    mask, logits, jnp.finfo(logits.dtype).min
+                )
+            probs = jax.nn.softmax(
+                logits.astype(jnp.float32), axis=-1
+            ).astype(x.dtype)
+            if drop_active:
+                probs = nn.Dropout(
+                    self.dropout_rate, deterministic=False
+                )(probs)
+            # [B,H,S_q,S_k] x [B,S_k,H,Dh] -> [B,H,S_q,Dh]
+            dn2 = (((3,), (1,)), ((0, 1), (0, 2)))
+            out = jax.lax.dot_general(probs, v, dn2)
+            out = jnp.swapaxes(out, 1, 2).reshape(b, s, d)
+        return nn.Dense(d, name="out")(out)
+
+
+__all__ = ["FusedSelfAttention"]

@@ -57,18 +57,15 @@ class EncoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, pad_mask, train: bool = False):
-        from ..ops.fused_attention import attention_fn
+        from .attention import FusedSelfAttention
 
         attn_mask = pad_mask[:, None, None, :]  # [B, 1, 1, L] keyed on keys
-        y = nn.MultiHeadDotProductAttention(
+        # packed-QKV attention in the [B, H, S, Dh] layout (attention.py);
+        # long sequences auto-route to the Pallas fused kernel
+        y = FusedSelfAttention(
             num_heads=self.nhead,
-            qkv_features=self.d_model,
-            deterministic=not train,
             dropout_rate=self.dropout_rate,
-            # Pallas fused attention for long sequences on TPU; flax's
-            # XLA path below the measured crossover (same param tree)
-            attention_fn=attention_fn,
-        )(x, x, mask=attn_mask)
+        )(x, mask=attn_mask, train=train)
         if self.attn_out_dropout:
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = nn.LayerNorm()(x + y)
@@ -108,6 +105,10 @@ class TransformerClassifier(nn.Module):
     pipeline_stages: int = 0
     pipeline_microbatches: int = 0
     pp_mesh: Any = None
+    #: inside an enclosing shard_map: pipeline by axis name — the SPMD
+    #: session owns the one shard_map and this module sees its LOCAL
+    #: trunk slice (parallel/spmd_pp.py; mirrors long_context's sp_axis)
+    pp_axis: str = ""
 
     def _layer(self) -> EncoderLayer:
         return EncoderLayer(self.d_model, self.nhead, 4 * self.d_model)
@@ -126,6 +127,13 @@ class TransformerClassifier(nn.Module):
         layer = self._layer()
         batch, seq, width = x.shape
 
+        # in pp_axis mode this module sees its device's LOCAL stage slice
+        # of the stacked trunk (the session's in_specs shard the leading
+        # layer axis) — declare the local shape so flax's param-shape
+        # check matches; real initialization always happens through the
+        # unsharded central model (pp_axis="")
+        init_layers = n_layers // stages if self.pp_axis else n_layers
+
         def init_trunk(rng):
             def init_one(r):
                 return layer.init(
@@ -135,7 +143,7 @@ class TransformerClassifier(nn.Module):
                     train=False,
                 )["params"]
 
-            return jax.vmap(init_one)(jax.random.split(rng, n_layers))
+            return jax.vmap(init_one)(jax.random.split(rng, init_layers))
 
         trunk = self.param("trunk", init_trunk)
         base_rng = (
@@ -172,6 +180,48 @@ class TransformerClassifier(nn.Module):
             base_rng, jnp.arange(n_micro)
         )
 
+        lps = n_layers // stages
+        pp_axis = self.pp_axis or "pp"
+
+        def stage_fn(params_here, carry):
+            # carry["pad"] is nonzero on PAD positions (uint8: the schedule
+            # psums the carry, which rejects bools) so the bubble ticks'
+            # all-zeros feed means "everything valid" — an all-False
+            # validity mask would drive softmax to NaN and poison the
+            # masked-out gradients through jnp.where
+            s_idx = lax.axis_index(pp_axis)
+            valid = carry["pad"] == 0
+
+            def body(xc, inp):
+                j, p_j = inp
+                g = s_idx * lps + j
+                return apply_layer(xc, valid, p_j, carry["rng"], g), None
+
+            out, _ = lax.scan(body, carry["x"], (jnp.arange(lps), params_here))
+            return {"x": out, "pad": carry["pad"], "rng": carry["rng"]}
+
+        if self.pp_axis:
+            # session-owned shard_map (parallel/spmd_pp.py): ``trunk``
+            # here is this device's LOCAL [lps, ...] stage slice (the
+            # session's in_specs shard the leading layer axis over pp);
+            # symmetric_out makes the session's per-leaf grad-sync rule
+            # exact (pipeline_body's docstring derives it)
+            if stages <= 1:
+                raise ValueError("pp_axis mode requires pipeline_stages > 1")
+            from ..parallel.pipeline import pipeline_body
+
+            micro = {"x": xs, "pad": pads.astype(jnp.uint8), "rng": rngs_mb}
+            result = pipeline_body(
+                stage_fn,
+                trunk,
+                micro,
+                axis_name=self.pp_axis,
+                n_stages=stages,
+                params_local=True,
+                symmetric_out=True,
+            )
+            return result["x"].reshape(batch, seq, width)
+
         if self.pp_mesh is None or stages == 1 or n_micro == 1:
 
             def run_mb(args):
@@ -189,27 +239,9 @@ class TransformerClassifier(nn.Module):
 
         from ..parallel.pipeline import pipeline_apply
 
-        lps = n_layers // stages
         stage_params = jax.tree.map(
             lambda p: p.reshape(stages, lps, *p.shape[1:]), trunk
         )
-
-        def stage_fn(params_here, carry):
-            # carry["pad"] is nonzero on PAD positions (uint8: the schedule
-            # psums the carry, which rejects bools) so the bubble ticks'
-            # all-zeros feed means "everything valid" — an all-False
-            # validity mask would drive softmax to NaN and poison the
-            # masked-out gradients through jnp.where
-            s_idx = lax.axis_index("pp")
-            valid = carry["pad"] == 0
-
-            def body(xc, inp):
-                j, p_j = inp
-                g = s_idx * lps + j
-                return apply_layer(xc, valid, p_j, carry["rng"], g), None
-
-            out, _ = lax.scan(body, carry["x"], (jnp.arange(lps), params_here))
-            return {"x": out, "pad": carry["pad"], "rng": carry["rng"]}
 
         micro = {"x": xs, "pad": pads.astype(jnp.uint8), "rng": rngs_mb}
         result = pipeline_apply(stage_fn, stage_params, micro, self.pp_mesh)
@@ -247,6 +279,7 @@ def _transformer(
     pipeline_stages: int = 0,
     pipeline_microbatches: int = 0,
     pp_mesh: Any = None,
+    pp_axis: str = "",
     **kwargs,
 ) -> ModelContext:
     meta = dataset_collection.metadata
@@ -261,6 +294,7 @@ def _transformer(
         pipeline_stages=pipeline_stages,
         pipeline_microbatches=pipeline_microbatches,
         pp_mesh=pp_mesh,
+        pp_axis=pp_axis,
     )
     # pretrained embedding init when both the ingested vectors and the
     # dataset's vocab are on disk (reference: word_vector_name, torchtext

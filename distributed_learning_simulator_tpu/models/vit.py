@@ -43,17 +43,16 @@ class ViTBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        from ..ops.fused_attention import attention_fn
+        from .attention import FusedSelfAttention
 
         y = nn.LayerNorm()(x)
-        y = nn.MultiHeadDotProductAttention(
+        # packed-QKV attention in the [B, H, S, Dh] layout (the flax MHA
+        # einsum layout costs 17% of the round in copies — attention.py);
+        # long patch sequences auto-route to the Pallas fused kernel
+        y = FusedSelfAttention(
             num_heads=self.num_heads,
-            deterministic=not train,
             dropout_rate=self.dropout_rate,
-            # auto-gated Pallas fused attention (no-op at ViT's seq 64,
-            # engaged for high-resolution / long-patch-sequence inputs)
-            attention_fn=attention_fn,
-        )(y, y)
+        )(y, train=train)
         x = x + nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         y = nn.LayerNorm()(x)
         return x + MlpBlock(self.mlp_dim, self.dropout_rate)(y, train=train)

@@ -1,0 +1,320 @@
+"""Short-sequence fused attention in the packed-QKV projection layout.
+
+The complement of ``ops/fused_attention.py`` at the OTHER end of the
+sequence axis.  The flash-style kernel there wins for T ≥ 1024, but the
+zoo's encoder workhorses (ViT at seq 64, BERT-tiny at 128) spend their
+attention time not in FLOPs — the score matrices are tiny — but in **XLA
+layout copies**: splitting heads out of the ``[B, S, H·Dh]`` projection
+and batching them for the MXU forces ``[B,S,H,Dh] ⇄ [B,H,S,Dh]``
+relayouts of every Q/K/V/residual tensor, measured at 17-25% of the
+whole ViT-small federated round on the v5e (BASELINE.md round-5 trace
+table; the reference runs the same architecture through torch SDPA and
+never sees this cost because cuDNN owns the layout there).
+
+This kernel removes the copies by never leaving the projection layout:
+
+* input is the packed ``[B, S, 3·H·Dh]`` output of ONE QKV matmul
+  (torch ``nn.MultiheadAttention``'s ``in_proj`` packing: Q rows, then
+  K, then V, each ``[S, H·Dh]`` with heads side by side);
+* each grid step loads a VMEM block of ``bb`` batch elements, unrolls
+  the (static) head loop, computes ``softmax(q_h k_hᵀ · Dh^-0.5) v_h``
+  per head with f32 scores, and writes straight into the ``[S, H·Dh]``
+  output block the next Dense consumes — heads are VMEM column slices,
+  never HBM transposes;
+* **MXU packing**: at ViT's S = 64 a single score matrix uses half the
+  128×128 systolic array, so ``bb = 128 // S_pad`` batch elements are
+  stacked into ONE ``[bb·S, bb·S]`` matmul per head — same MXU cycles,
+  ``bb×`` fewer matmuls — with an in-kernel block-diagonal iota mask
+  zeroing the cross-element quadrants (their probabilities are exactly
+  0, which also makes every backward contraction block-correct);
+* backward is one kernel in the same layout producing ``d(qkv)``
+  directly (recompute-style: probabilities are re-formed from the saved
+  input, nothing but the projection itself is kept as residual).
+
+Sequences are padded to the sublane multiple and padded KEYS are masked
+with an in-kernel iota compare; padded QUERY rows compute garbage that
+the caller slices off.  ``kv_mask`` ([B, S] 1/0) handles text-model key
+padding the same way.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .fused_attention import _interp, _mode
+
+_NEG_INF = -1e30
+MAX_SHORT_T = 1024  # hand-off point to the flash-style long-seq kernel
+_VMEM_BUDGET = 13 * 1024 * 1024
+
+
+def short_eligible(
+    s: int, d_model: int, num_heads: int, itemsize: int = 2
+) -> bool:
+    """Can this kernel serve a ``[B, S, 3·d_model]`` packed projection?
+    Head dim must be a clean lane fraction (64 or 128) and the whole
+    per-block working set must fit VMEM."""
+    if _mode() == "off":
+        return False
+    if d_model % num_heads:
+        return False
+    dh = d_model // num_heads
+    if dh not in (64, 128) or d_model % 128:
+        return False
+    if s > MAX_SHORT_T:
+        return False
+    rows = max(_pad_rows(s), 128)  # bb packing targets 128 score rows
+    working = 4 * d_model * rows * itemsize + 4 * rows * rows * 4
+    return working <= _VMEM_BUDGET
+
+
+def _pad_rows(s: int) -> int:
+    return (s + 15) // 16 * 16
+
+
+def _pick_bb(b: int, s_pad: int) -> int:
+    """Batch elements stacked per score matmul: fill the 128-row MXU tile
+    at short S (must divide the batch)."""
+    bb = max(1, 128 // s_pad)
+    while b % bb:
+        bb -= 1
+    return bb
+
+
+def _pick_blk_b(b: int, s_pad: int, bb: int) -> int:
+    """Batch elements per GRID STEP (a multiple of ``bb``).  Measured on
+    the v5e ViT-small round: ONE stacked group per step wins — 1.655
+    rounds/s vs 1.616 (2 groups/step) and 1.574 (4 groups/step); Mosaic's
+    cross-step DMA/compute overlap beats in-step unrolling here, so the
+    group loop in the kernels exists only for shapes where ``b`` is not
+    divisible by ``bb`` stacking (it then runs a single group anyway)."""
+    return bb
+
+
+def _head_slices(qkv, d: int, dh: int, h: int):
+    """Head ``h``'s (q, k, v) column slices of one packed block."""
+    q = qkv[:, h * dh : (h + 1) * dh]
+    k = qkv[:, d + h * dh : d + (h + 1) * dh]
+    v = qkv[:, 2 * d + h * dh : 2 * d + (h + 1) * dh]
+    return q, k, v
+
+
+def _probs(q, k, mask_row, scale, s_true, s_pad):
+    """f32 attention probabilities for one head (shared fwd/bwd).
+    ``q``/``k`` are ``[bb·S_pad, Dh]``; rows/cols from different batch
+    elements of the stack are masked to exact 0."""
+    logits = (
+        jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )
+    rows = logits.shape[0]
+    keep = None
+    if rows > s_pad:  # block-diagonal mask across the bb stack
+        r = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+        c = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        keep = (r // s_pad) == (c // s_pad)
+    if s_true < s_pad:  # padded key columns
+        c = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        pad_ok = (c % s_pad) < s_true
+        keep = pad_ok if keep is None else (keep & pad_ok)
+    if keep is not None:
+        logits = jnp.where(keep, logits, _NEG_INF)
+    if mask_row is not None:
+        logits = jnp.where(mask_row > 0, logits, _NEG_INF)
+    m = jnp.max(logits, axis=1, keepdims=True)
+    p = jnp.exp(logits - m)
+    return p / jnp.sum(p, axis=1, keepdims=True)
+
+
+def _fwd_kernel(*refs, heads, dh, scale, s_true, s_pad, bb, masked):
+    if masked:
+        qkv_ref, mask_ref, out_ref = refs
+    else:
+        qkv_ref, out_ref = refs
+        mask_ref = None
+    width = qkv_ref.shape[2]
+    d = heads * dh
+    groups = qkv_ref.shape[0] // bb
+    for g in range(groups):
+        rows = slice(g * bb, (g + 1) * bb)
+        qkv = qkv_ref[rows].reshape(bb * s_pad, width)
+        mask_row = None if mask_ref is None else mask_ref[g : g + 1, :]
+        for h in range(heads):
+            q, k, v = _head_slices(qkv, d, dh, h)
+            p = _probs(q, k, mask_row, scale, s_true, s_pad)
+            out_h = jax.lax.dot_general(
+                p.astype(qkv.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            out_ref[rows, :, h * dh : (h + 1) * dh] = out_h.astype(
+                out_ref.dtype
+            ).reshape(bb, s_pad, dh)
+
+
+def _bwd_kernel(*refs, heads, dh, scale, s_true, s_pad, bb, masked):
+    if masked:
+        qkv_ref, mask_ref, do_ref, dqkv_ref = refs
+    else:
+        qkv_ref, do_ref, dqkv_ref = refs
+        mask_ref = None
+    width = qkv_ref.shape[2]
+    d = heads * dh
+    dt = dqkv_ref.dtype
+    groups = qkv_ref.shape[0] // bb
+    for g in range(groups):
+        rows = slice(g * bb, (g + 1) * bb)
+        qkv = qkv_ref[rows].reshape(bb * s_pad, width)
+        do = do_ref[rows].reshape(bb * s_pad, d)
+        mask_row = None if mask_ref is None else mask_ref[g : g + 1, :]
+        for h in range(heads):
+            q, k, v = _head_slices(qkv, d, dh, h)
+            p = _probs(q, k, mask_row, scale, s_true, s_pad)
+            do_h = do[:, h * dh : (h + 1) * dh]
+            p_low = p.astype(qkv.dtype)
+            dv = jax.lax.dot_general(
+                p_low, do_h, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do_h, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            # cross-element quadrants of dp are garbage, but p is exactly
+            # 0 there, so ds (= p ⊙ (dp − rowsum(dp ⊙ p))) stays correct
+            ds = p * (dp - jnp.sum(dp * p, axis=1, keepdims=True))
+            ds = (ds * scale).astype(qkv.dtype)
+            dq = jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dk = jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dqkv_ref[rows, :, h * dh : (h + 1) * dh] = dq.astype(
+                dt
+            ).reshape(bb, s_pad, dh)
+            dqkv_ref[rows, :, d + h * dh : d + (h + 1) * dh] = dk.astype(
+                dt
+            ).reshape(bb, s_pad, dh)
+            dqkv_ref[
+                rows, :, 2 * d + h * dh : 2 * d + (h + 1) * dh
+            ] = dv.astype(dt).reshape(bb, s_pad, dh)
+
+
+def _call(kernel, qkv, mask, extra, out_shape, *, heads, dh, s_true):
+    """Shared pallas_call plumbing: ``blk_b`` batch elements per grid
+    step, unrolled in-kernel as ``blk_b // bb`` MXU-packed groups."""
+    b, s_pad, width = qkv.shape
+    bb = _pick_bb(b, s_pad)
+    blk_b = _pick_blk_b(b, s_pad, bb)
+    masked = mask is not None
+    operands = [qkv] + ([mask] if masked else []) + extra
+    specs = [pl.BlockSpec((blk_b, s_pad, width), lambda i: (i, 0, 0))]
+    if masked:
+        # wrapper pre-flattens the mask to [B//bb, bb·S_pad]
+        specs.append(
+            pl.BlockSpec((blk_b // bb, bb * s_pad), lambda i: (i, 0))
+        )
+    specs += [
+        pl.BlockSpec(
+            (blk_b,) + x.shape[1:],
+            lambda i, n=x.ndim: (i,) + (0,) * (n - 1),
+        )
+        for x in extra
+    ]
+    return pl.pallas_call(
+        functools.partial(
+            kernel,
+            heads=heads,
+            dh=dh,
+            scale=dh**-0.5,
+            s_true=s_true,
+            s_pad=s_pad,
+            bb=bb,
+            masked=masked,
+        ),
+        grid=(b // blk_b,),
+        in_specs=specs,
+        out_specs=pl.BlockSpec(
+            (blk_b,) + out_shape.shape[1:],
+            lambda i: (i,) + (0,) * (len(out_shape.shape) - 1),
+        ),
+        out_shape=out_shape,
+        interpret=_interp(_mode() == "interpret"),
+    )(*operands)
+
+
+def _flat_mask(kv_mask, b: int, s_pad: int):
+    """[B, S_pad] → [B//bb, bb·S_pad] so the kernel reads a lane-major
+    row vector per block (no in-kernel sublane→lane reshape)."""
+    bb = _pick_bb(b, s_pad)
+    return kv_mask.astype(jnp.float32).reshape(b // bb, bb * s_pad)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _short_attn(qkv, kv_mask, heads: int, s_true: int):
+    out, _ = _short_fwd(qkv, kv_mask, heads, s_true)
+    return out
+
+
+def _short_fwd(qkv, kv_mask, heads: int, s_true: int):
+    b, s_pad, width = qkv.shape
+    d = width // 3
+    mask = None if kv_mask is None else _flat_mask(kv_mask, b, s_pad)
+    out = _call(
+        _fwd_kernel,
+        qkv,
+        mask,
+        [],
+        jax.ShapeDtypeStruct((b, s_pad, d), qkv.dtype),
+        heads=heads,
+        dh=d // heads,
+        s_true=s_true,
+    )
+    return out, (qkv, kv_mask)
+
+
+def _short_bwd(heads: int, s_true: int, res, do):
+    qkv, kv_mask = res
+    b, s_pad, _ = qkv.shape
+    mask = None if kv_mask is None else _flat_mask(kv_mask, b, s_pad)
+    dqkv = _call(
+        _bwd_kernel,
+        qkv,
+        mask,
+        [do],
+        jax.ShapeDtypeStruct(qkv.shape, qkv.dtype),
+        heads=heads,
+        dh=qkv.shape[2] // 3 // heads,
+        s_true=s_true,
+    )
+    return dqkv, None
+
+
+_short_attn.defvjp(_short_fwd, _short_bwd)
+
+
+def short_attention(qkv, num_heads: int, kv_mask=None):
+    """``softmax(QKᵀ·Dh^-0.5)V`` over a packed ``[B, S, 3·H·Dh]``
+    projection, returning ``[B, S, H·Dh]``.  ``kv_mask``: optional
+    ``[B, S]`` key-padding mask (>0 = attend).  Caller gates via
+    :func:`short_eligible`."""
+    b, s, width = qkv.shape
+    s_pad = _pad_rows(s)
+    if s_pad != s:
+        qkv = jnp.pad(qkv, ((0, 0), (0, s_pad - s), (0, 0)))
+        if kv_mask is not None:
+            kv_mask = jnp.pad(kv_mask, ((0, 0), (0, s_pad - s)))
+    if kv_mask is not None:
+        kv_mask = kv_mask.astype(jnp.float32)
+    out = _short_attn(qkv, kv_mask, num_heads, s)
+    return out[:, :s, :]
+
+
+__all__ = ["short_attention", "short_eligible", "MAX_SHORT_T"]

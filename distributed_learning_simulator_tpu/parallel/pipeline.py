@@ -39,18 +39,31 @@ def pipeline_body(
     *,
     axis_name: str,
     n_stages: int,
+    params_local: bool = False,
+    symmetric_out: bool = False,
 ):
     """The shard_map body: run ``microbatches`` (pytree of ``[M, mb, ...]``)
     through the S-stage pipeline.  ``stage_params`` is this device's slice
-    ``[1, ...]`` of the stacked stage parameters; ``stage_fn(params, tree)``
+    ``[1, ...]`` of the stacked stage parameters (already the bare local
+    slice when ``params_local`` — the session-owned shard_map mode, where
+    the caller's in_specs did the slicing); ``stage_fn(params, tree)``
     maps a carry pytree to a carry pytree of identical structure/shape.
 
     Returns the last stage's outputs ``[M, mb, ...]``, already ``psum``-ed
     over the pipeline axis so the result is replicated (only the last stage
-    contributes non-zeros).
+    contributes non-zeros).  ``symmetric_out`` routes that psum through
+    ``psum_symmetric`` — required when ``jax.grad`` runs INSIDE the
+    enclosing shard_map (``parallel/spmd_pp.py``: the ×S upstream
+    cotangent makes one per-leaf sync rule correct for the whole tree);
+    the plain psum is correct when grad runs OUTSIDE (the threaded mode,
+    where shard_map's own transpose machinery owns the accounting).
     """
     s_idx = jax.lax.axis_index(axis_name)
-    params_here = jax.tree.map(lambda p: p[0], stage_params)
+    params_here = (
+        stage_params
+        if params_local
+        else jax.tree.map(lambda p: p[0], stage_params)
+    )
     n_micro = jax.tree.leaves(microbatches)[0].shape[0]
     n_ticks = n_micro + n_stages - 1
 
@@ -103,9 +116,18 @@ def pipeline_body(
         tick, (zero_carry, outputs0), jnp.arange(n_ticks)
     )
     # only the last stage wrote real values; replicate them
+    from .collectives import psum_symmetric
+
+    def reduce(v):
+        # integer leaves (pad masks, rng keys) carry no gradient — keep
+        # them on the plain psum even in symmetric mode
+        if symmetric_out and jnp.issubdtype(v.dtype, jnp.inexact):
+            return psum_symmetric(v, axis_name)
+        return jax.lax.psum(v, axis_name)
+
     return jax.tree.map(
-        lambda o: jax.lax.psum(
-            jnp.where(s_idx == n_stages - 1, o, jnp.zeros_like(o)), axis_name
+        lambda o: reduce(
+            jnp.where(s_idx == n_stages - 1, o, jnp.zeros_like(o))
         ),
         outputs,
     )

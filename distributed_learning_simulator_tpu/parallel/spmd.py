@@ -163,7 +163,6 @@ def scan_weighted_clients(
     data,
     weights,
     rngs,
-    params_shape,
     metrics_shape,
 ):
     """Clients one after another as a ``lax.scan`` (the round body of the
@@ -192,8 +191,11 @@ def scan_weighted_clients(
         )
         return (acc_params, acc_metrics), None
 
+    # accumulator shapes come from the params ACTUALLY in scope — under a
+    # sharding session's shard_map these are local slices (pp: the trunk's
+    # stage slice), not the unsharded template shapes
     zero_params = jax.tree.map(
-        lambda s: jnp.zeros(s.shape, jnp.float32), params_shape
+        lambda g: jnp.zeros(g.shape, jnp.float32), global_params
     )
     zero_metrics = jax.tree.map(
         lambda s: jnp.zeros(s.shape, s.dtype), metrics_shape

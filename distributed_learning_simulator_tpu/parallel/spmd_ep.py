@@ -101,12 +101,12 @@ class SpmdExpertParallelSession(SpmdFedAvgSession):
         engine = self._ep_engine
         epochs = self.config.epoch
         mesh = self.mesh
-        params_shape, metrics_shape = whole_mesh_session_shapes(self)
+        _, metrics_shape = whole_mesh_session_shapes(self)
 
         def round_program(global_params, weights, rngs, data):
             return scan_weighted_clients(
                 engine, epochs, global_params, data, weights, rngs,
-                params_shape, metrics_shape,
+                metrics_shape,
             )
 
         # out_shardings pin the new globals to the stored expert layout so

@@ -1,0 +1,165 @@
+"""FedAvg rounds with pipeline-parallel clients as one SPMD program.
+
+Round-4's pipeline parallelism ran only on the threaded executor (the
+MODEL owned a ``pp`` mesh via its own ``shard_map`` — ``models/text.py``);
+this session brings ``model_kwargs.pipeline_stages`` to the TPU-first
+SPMD path the way ``spmd_sp.py`` did for sequence parallelism (VERDICT
+r4 item 2): the SESSION owns an ``("pp",)`` mesh and the one
+``shard_map``; each client's model runs in ``pp_axis`` mode (GPipe
+schedule by axis name over its LOCAL stage slice —
+``parallel/pipeline.py``), and clients scan through the trunk inside one
+round program with on-device weighted aggregation.
+
+Gradient correctness (the part that is genuinely different from SP):
+inside the session's shard_map the engine differentiates ONE device's
+loss.  Stage-sharded trunk leaves arrive as local slices — their
+gradients are local and must NOT be cross-device reduced — while
+replicated leaves (embed, head, ...) get PARTIAL per-device
+contributions (the reverse-ppermute schedule routes each cotangent to
+the stage that produced it).  ``pipeline_body``'s ``symmetric_out``
+(``psum_symmetric``, ``parallel/collectives.py``) multiplies every
+upstream cotangent by S, after which ONE per-leaf rule is exact:
+
+* replicated leaf:  ``pmean_d(S · partial_d) = sum_d partial_d``  ✓
+  (downstream-of-the-psum leaves are full on every device and pmean is
+  the identity on them);
+* trunk (pp-sharded) leaf: local gradient is ``S · true`` → divide by
+  S locally, no collective.
+
+The engine applies this via ``grad_sync_fn`` (``engine/engine.py``).
+
+Inherited unchanged from ``SpmdFedAvgSession``: run loop, selection,
+round records, checkpoints, watchdog, resume, and the client-axis rng
+contract (equivalence with ``pipeline_stages=1`` on the client-axis
+session is pinned by ``tests/test_pipeline_config.py``).
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..engine.engine import ComputeEngine
+from .spmd import (
+    SpmdFedAvgSession,
+    scan_weighted_clients,
+    shard_map_compat,
+    whole_mesh_session_shapes,
+)
+from .spmd_sp import SingleDeviceEvalMixin
+
+
+class SpmdPipelineSession(SingleDeviceEvalMixin, SpmdFedAvgSession):
+    def __init__(
+        self,
+        config,
+        dataset_collection,
+        model_ctx,
+        engine: ComputeEngine,
+        practitioners,
+        pipeline_stages: int,
+        pipeline_microbatches: int = 0,
+    ) -> None:
+        devices = jax.devices()
+        if pipeline_stages > len(devices):
+            raise ValueError(
+                f"pipeline_stages={pipeline_stages} exceeds the "
+                f"{len(devices)}-device mesh"
+            )
+        pp_mesh = Mesh(
+            np.asarray(devices[:pipeline_stages]), axis_names=("pp",)
+        )
+        self._pp_stages = pipeline_stages
+        # the pp-mode twin: same factory, same parameter structure
+        # (stacked trunk), forward written for the session's axis
+        from ..models import create_model_context
+
+        kwargs = dict(getattr(config, "model_kwargs", {}) or {})
+        kwargs.pop("pp_mesh", None)
+        kwargs["pipeline_stages"] = pipeline_stages
+        if pipeline_microbatches:
+            kwargs["pipeline_microbatches"] = pipeline_microbatches
+        kwargs["pp_axis"] = "pp"
+        pp_model_ctx = create_model_context(
+            config.model_name, dataset_collection, **kwargs
+        )
+        pp_model_ctx.compute_dtype = model_ctx.compute_dtype
+
+        stages = float(pipeline_stages)
+
+        def grad_sync(grads):
+            # sharded-vs-replicated must be decided from the GLOBAL layout
+            # (self._param_specs, template shapes) — inside the shard_map
+            # the trunk gradients are local slices whose leading dim is
+            # lps, which _leaf_spec would misclassify as replicated
+            return {
+                k: g / stages
+                if self._param_specs[k] != P()
+                else jax.lax.pmean(g, "pp")
+                for k, g in grads.items()
+            }
+
+        self._pp_engine = ComputeEngine(
+            pp_model_ctx,
+            engine.hyper_parameter,
+            total_steps=engine.total_steps,
+            grad_sync_fn=grad_sync,
+        )
+        super().__init__(
+            config, dataset_collection, model_ctx, engine, practitioners,
+            mesh=pp_mesh,
+        )
+
+    def _leaf_spec(self, shape, name: str = "") -> P:
+        """The stacked trunk's leading layer axis shards over pp (each
+        device gets its stage's contiguous layers); everything else
+        (embed, positional, head) is replicated."""
+        if (
+            name.startswith("trunk")
+            and shape
+            and shape[0] % self._pp_stages == 0
+        ):
+            return P("pp")
+        return P()
+
+    def _build_round_fn(self):
+        engine = self._pp_engine
+        epochs = self.config.epoch
+        mesh = self.mesh
+        _, metrics_shape = whole_mesh_session_shapes(self)
+        param_specs = self._param_specs
+
+        def round_program(global_params, weights, rngs, data):
+            def shard_body(global_params, data, weights, rngs):
+                # trunk leaves here are LOCAL stage slices; data/weights/
+                # rngs replicated (every stage sees the full batch — the
+                # schedule's stage-0 select feeds it into the pipe)
+                return scan_weighted_clients(
+                    engine, epochs, global_params, data, weights, rngs,
+                    metrics_shape,
+                )
+
+            return shard_map_compat(
+                shard_body,
+                mesh,
+                in_specs=(param_specs, P(), P(), P()),
+                out_specs=(param_specs, P()),
+            )(global_params, data, weights, rngs)
+
+        jitted = jax.jit(round_program, donate_argnums=(0,))
+
+        def fn(global_params, weights, rngs):
+            return jitted(global_params, weights, rngs, self._data)
+
+        return fn
+
+
+def build_pipeline_session(ctx, session_args, session_kwargs):
+    config = ctx.config
+    model_kwargs = dict(config.model_kwargs)
+    return SpmdPipelineSession(
+        *session_args,
+        pipeline_stages=int(model_kwargs.get("pipeline_stages", 0)),
+        pipeline_microbatches=int(
+            model_kwargs.get("pipeline_microbatches", 0)
+        ),
+    )

@@ -42,7 +42,43 @@ from .spmd import (
 )
 
 
-class SpmdSequenceParallelSession(SpmdFedAvgSession):
+class SingleDeviceEvalMixin:
+    """Central evaluation on ONE device for whole-mesh-per-client
+    sessions (sp/pp): the base class evaluates on mesh-replicated arrays,
+    which partitions the eval jit over the session mesh — wasted for a
+    replicated program and incompatible with the Pallas interpreter
+    (``DLS_TPU_FUSED_ATTN=interpret``: an ``io_callback`` cannot live
+    inside a partitioned program)."""
+
+    def _evaluate(self, global_params) -> dict:
+        if jax.process_count() > 1:
+            # a multi-host pod cannot device_put to one global device
+            # (non-addressable from the other processes) — keep the base
+            # class's put_sharded replicated path there
+            return super()._evaluate(global_params)
+        from ..engine.engine import maybe_slow_metrics, summarize_metrics
+        from ..ml_type import MachineLearningPhase as Phase
+
+        device = self.mesh.devices.flat[0]
+        if self._eval_batches is None:
+            from ..engine.batching import make_epoch_batches
+
+            test = self.dc.get_dataset(Phase.Test)
+            self._eval_batches = jax.device_put(
+                make_epoch_batches(test, self.config.batch_size), device
+            )
+        params = jax.device_put(global_params, device)
+        summed = self.engine.evaluate(params, self._eval_batches)
+        metric = summarize_metrics(summed)
+        metric.update(
+            maybe_slow_metrics(
+                self.config, self.engine, params, self._eval_batches
+            )
+        )
+        return metric
+
+
+class SpmdSequenceParallelSession(SingleDeviceEvalMixin, SpmdFedAvgSession):
     def __init__(
         self,
         config,
@@ -108,45 +144,11 @@ class SpmdSequenceParallelSession(SpmdFedAvgSession):
     def _leaf_spec(self, shape, name: str = "") -> P:
         return P()  # params replicated; the sequence axis is the sharded one
 
-    def _evaluate(self, global_params) -> dict:
-        """Central evaluation on ONE device — the documented unsharded
-        path (Pallas fused/streaming attention at long sequence).  The
-        base class evaluates on mesh-replicated arrays, which partitions
-        the eval jit over the sp mesh; that is wasted for a replicated
-        program and breaks the Pallas interpreter
-        (``DLS_TPU_FUSED_ATTN=interpret``: an ``io_callback`` cannot live
-        inside a partitioned program)."""
-        if jax.process_count() > 1:
-            # a multi-host pod cannot device_put to one global device
-            # (non-addressable from the other processes) — keep the base
-            # class's put_sharded replicated path there
-            return super()._evaluate(global_params)
-        from ..engine.engine import maybe_slow_metrics, summarize_metrics
-        from ..ml_type import MachineLearningPhase as Phase
-
-        device = self.mesh.devices.flat[0]
-        if self._eval_batches is None:
-            from ..engine.batching import make_epoch_batches
-
-            test = self.dc.get_dataset(Phase.Test)
-            self._eval_batches = jax.device_put(
-                make_epoch_batches(test, self.config.batch_size), device
-            )
-        params = jax.device_put(global_params, device)
-        summed = self.engine.evaluate(params, self._eval_batches)
-        metric = summarize_metrics(summed)
-        metric.update(
-            maybe_slow_metrics(
-                self.config, self.engine, params, self._eval_batches
-            )
-        )
-        return metric
-
     def _build_round_fn(self):
         engine = self._sp_engine
         epochs = self.config.epoch
         mesh = self.mesh
-        params_shape, metrics_shape = whole_mesh_session_shapes(self)
+        _, metrics_shape = whole_mesh_session_shapes(self)
 
         def round_program(global_params, weights, rngs, data):
             def shard_body(global_params, data, weights, rngs):
@@ -154,7 +156,7 @@ class SpmdSequenceParallelSession(SpmdFedAvgSession):
                 # for the token input); params/weights/rngs are replicated
                 return scan_weighted_clients(
                     engine, epochs, global_params, data, weights, rngs,
-                    params_shape, metrics_shape,
+                    metrics_shape,
                 )
 
             data_specs = jax.tree.map(

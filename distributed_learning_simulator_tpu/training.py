@@ -104,9 +104,12 @@ def _build_task(
     # unsharded for central evaluation.
     model_kwargs.pop("expert_parallel", None)
     # ``model_kwargs.pipeline_stages: S`` — GPipe the model's encoder
-    # trunk over a ("pp",) mesh of S devices (parallel/pipeline.py).  The
-    # MODEL owns the mesh (like the threaded sp_mesh mode): the config
-    # carries the stage count, the mesh is built here.
+    # trunk over a ("pp",) mesh of S devices (parallel/pipeline.py).
+    # Under the SPMD executor the SESSION owns the mesh and builds a
+    # pp-axis twin (parallel/spmd_pp.py) — the task's model_ctx stays
+    # mesh-free (stacked sequential layout) for central evaluation.
+    # Under the threaded executor the MODEL owns the mesh (like the
+    # threaded sp_mesh mode): the mesh is built here.
     pipeline_stages = int(model_kwargs.get("pipeline_stages", 0))
     if int(model_kwargs.get("pipeline_microbatches", 0)) and not pipeline_stages:
         raise ValueError(
@@ -123,7 +126,7 @@ def _build_task(
             "pipeline_stages and expert_parallel are separate sharding "
             "layouts; set one"
         )
-    if pipeline_stages > 1:
+    if pipeline_stages > 1 and resolve_executor(config) != "spmd":
         import jax
         from jax.sharding import Mesh
 
@@ -465,10 +468,19 @@ def resolve_executor(config) -> str:
             )
         return "spmd"
     if int(dict(config.model_kwargs).get("pipeline_stages", 0)) > 1:
+        if config.distributed_algorithm == "fed_avg":
+            if executor == "sequential":
+                # explicit opt-in to the threaded layout (model owns the
+                # pp mesh via its own shard_map, models/text.py)
+                return "sequential"
+            # TPU-first default: the SPMD session owns the ("pp",) mesh
+            # and clients scan through the GPipe trunk in one program
+            return "spmd"
         if executor == "spmd":
             raise ValueError(
-                "pipeline_stages runs on the threaded executor (the model "
-                "owns the pp mesh, models/text.py); drop executor=spmd"
+                "pipeline_stages under executor=spmd is implemented for "
+                "fed_avg (parallel/spmd_pp.py); other methods run it on "
+                "the threaded executor (the model owns the pp mesh)"
             )
         return "sequential"
     if executor != "auto":
@@ -498,6 +510,19 @@ def resolve_executor(config) -> str:
 
 def _make_spmd_session(ctx: TaskContext):
     model_kwargs = dict(ctx.config.model_kwargs)
+    if int(model_kwargs.get("pipeline_stages", 0)) > 1:
+        # _build_task already rejected pipeline × sp/ep combinations and
+        # resolve_executor pinned non-fed_avg to the threaded executor
+        from .parallel.spmd_pp import build_pipeline_session
+
+        session_args = (
+            ctx.config,
+            ctx.dataset_collection,
+            ctx.model_ctx,
+            ctx.engine,
+            ctx.practitioners,
+        )
+        return build_pipeline_session(ctx, session_args, {})
     if int(model_kwargs.get("expert_parallel", 0)):
         if int(model_kwargs.get("sequence_parallel", 0)):
             raise ValueError(

@@ -138,3 +138,43 @@ def test_resnet50_is_bottleneck_25_6M():
     )
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
     assert 25.0e6 < n_params < 26.2e6, n_params
+
+
+def test_causal_lm_transformer_causality_and_loss():
+    """CausalLMTransformer: per-token vocab logits, strict causality
+    (changing a future token must not change earlier logits), and
+    next-token CE through masked_ce_loss's elementwise [B, L, V] path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_learning_simulator_tpu.models.long_context import (
+        LongContextTransformer,
+    )
+    from distributed_learning_simulator_tpu.models.registry import (
+        masked_ce_loss,
+    )
+
+    vocab = 97
+    m = LongContextTransformer(
+        vocab_size=vocab, num_classes=vocab, d_model=32, nhead=2,
+        num_encoder_layer=2, max_len=48, causal=True, lm_head=True,
+        dropout_rate=0.0,
+    )
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(1, vocab, (2, 48)), jnp.int32
+    )
+    params = m.init(jax.random.PRNGKey(0), toks)
+    logits = m.apply(params, toks)
+    assert logits.shape == (2, 48, vocab)
+
+    bumped = m.apply(params, toks.at[:, 30].set(7))
+    np.testing.assert_allclose(
+        np.asarray(logits[:, :30]), np.asarray(bumped[:, :30]), atol=1e-5
+    )
+    assert not np.allclose(np.asarray(logits[:, 30:]), np.asarray(bumped[:, 30:]))
+
+    targets = jnp.concatenate([toks[:, 1:], toks[:, :1]], axis=1)
+    mask = jnp.ones_like(toks)
+    loss, aux = masked_ce_loss(logits, targets, mask)
+    assert float(loss) > 0 and float(aux["count"]) == 96.0

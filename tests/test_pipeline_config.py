@@ -57,9 +57,36 @@ def test_pipeline_two_stages_two_layers_each():
     assert np.isfinite(result["performance"][1]["test_loss"])
 
 
-def test_pipeline_rejects_spmd_executor():
+def test_spmd_pipeline_session_matches_client_axis_session():
+    """fed_avg + pipeline_stages under executor auto runs the dedicated
+    SPMD session (session-owned ("pp",) mesh, clients scanned through the
+    GPipe trunk — parallel/spmd_pp.py).  Same stacked params, same rng
+    contract as the client-axis session running the stages=1 stacked
+    trunk, and the per-leaf grad sync is exact (psum_symmetric boundary)
+    — so the trajectories must agree."""
+    spmd_pp = _config(pipeline_stages=4, pipeline_microbatches=4)
+    spmd_pp.executor = "auto"
+    spmd_pp.round = 2
+    pp = train(spmd_pp)
+
+    base_config = _config(pipeline_stages=1, pipeline_microbatches=4)
+    base_config.executor = "auto"
+    base_config.round = 2
+    base = train(base_config)
+    for round_number in (1, 2):
+        for key in ("test_loss", "test_accuracy"):
+            np.testing.assert_allclose(
+                pp["performance"][round_number][key],
+                base["performance"][round_number][key],
+                atol=2e-4,
+            )
+
+
+def test_pipeline_rejects_spmd_for_other_methods():
     config = _config(pipeline_stages=4)
     config.executor = "spmd"
+    config.distributed_algorithm = "fed_paq"
+    config.endpoint_kwargs = {"worker": {"quantization_level": 255}}
     with pytest.raises(ValueError, match="pipeline_stages"):
         train(config)
 
