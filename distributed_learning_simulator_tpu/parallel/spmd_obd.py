@@ -84,10 +84,15 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         self._phase1_fn = self._build_phase_fn(phase_two=False)
         return self._phase1_fn
 
+    def _train_engine(self):
+        """The engine the round program trains with — a sharded-model twin
+        in the expert-parallel subclass."""
+        return self.engine
+
     def _build_phase_fn(self, phase_two: bool):
         import math
 
-        engine = self.engine
+        engine = self._train_engine()
         epochs = 1 if phase_two else self.config.epoch
         weight_cfg = self._nnadq_weight
         block_sizes = jnp.asarray(self._block_sizes)
@@ -172,6 +177,14 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             contribution = jax.tree.map(lambda p: p * weight, upload)
             summed = dict(summed, upload_bits=upload_bits * selected)
             return contribution, opt_out, summed
+
+        return self._wrap_phase_program(local_train, qdq, phase_two)
+
+    def _wrap_phase_program(self, local_train, qdq, phase_two: bool):
+        """Client-axis layout: slots over the ``clients`` mesh axis,
+        chunk-scanned vmap inside ``shard_map``, psum aggregation.  The
+        expert-parallel subclass overrides this with a whole-mesh-per-
+        client GSPMD layout (clients as a plain scan)."""
 
         def chunk_size(slots_local: int) -> int:
             mb = self.client_chunk

@@ -1,0 +1,194 @@
+"""FedOBD with expert-parallel MoE clients — the north-star method on a
+model-sharding axis (VERDICT r4 item 3).
+
+Round 4 left ``expert_parallel`` fed_avg-only; this session composes it
+with the flagship FedOBD method (reference workload
+``fed_obd_train.sh`` / BASELINE.json "fed_obd + fed_obd_sq").  The key
+observation making the composition cheap: every FedOBD-specific op —
+per-block L2 scoring, greedy keep under the budget, NNADQ/QSGD
+distortion, ``complete()``'s where-fallback, the weighted sum — is a
+per-leaf elementwise/reduction op, so it commutes with GSPMD's expert
+sharding.  The layout is therefore ``spmd_ep.py``'s: an ``("ep",)``
+mesh, expert-stacked kernels stored ``P("ep", None, None)``, clients
+scanned one after another in a plain ``jit`` whose sharding constraints
+(``models/moe.py``) let XLA place the dispatch/combine all-to-alls.
+
+The per-client math (``local_train``: block dropout, codec, optimizer
+continuation) is inherited VERBATIM from ``SpmdFedOBDSession`` — only
+``_wrap_phase_program`` (how clients map onto the mesh) changes, so the
+equivalence test pins ep=N against the client-axis FedOBD trajectory
+with the identical rng stream (``jax.random.split``'s per-index streams
+do not depend on the slot count).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..engine.engine import ComputeEngine
+from .spmd_obd import SpmdFedOBDSession
+
+
+def obd_scan_round_program(local_train, qdq, phase_two: bool):
+    """The whole-mesh-per-client FedOBD round: clients as a ``lax.scan``
+    with on-device weighted accumulation and the quantized broadcast —
+    shared by the expert-parallel (GSPMD jit) and sequence-parallel
+    (session shard_map) layouts."""
+
+    def round_program(
+        global_params, opt_state_s, weights, rngs, bcast_rng, data
+    ):
+        zero_params = jax.tree.map(
+            lambda g: jnp.zeros(g.shape, jnp.float32), global_params
+        )
+        first = jax.tree.map(lambda x: x[0], data)
+        _, _, met_shapes = jax.eval_shape(
+            local_train, global_params, first, weights[0], rngs[0], None
+        )
+        zero_metrics = jax.tree.map(
+            lambda s: jnp.zeros((), s.dtype), met_shapes
+        )
+
+        def client_body(acc, xs):
+            if phase_two:
+                cdata, w, r, opt = xs
+            else:
+                cdata, w, r = xs
+                opt = None
+            contrib, opt_out, met = local_train(
+                global_params, cdata, w, r, opt
+            )
+            acc_sum, acc_met = acc
+            acc_sum = jax.tree.map(lambda a, c: a + c, acc_sum, contrib)
+            # NOTE: metrics sum unconditionally, matching the client-axis
+            # shard_body (unselected slots still train, masked only in
+            # the weighted param sum)
+            acc_met = jax.tree.map(lambda a, m: a + m, acc_met, met)
+            return (acc_sum, acc_met), opt_out
+
+        xs = (
+            (data, weights, rngs, opt_state_s)
+            if phase_two
+            else (data, weights, rngs)
+        )
+        (local_sum, metrics), opt_out = jax.lax.scan(
+            client_body, (zero_params, zero_metrics), xs
+        )
+        total_weight = jnp.maximum(jnp.sum(weights), 1e-12)
+        new_global = jax.tree.map(
+            lambda s, g: (s / total_weight).astype(g.dtype),
+            local_sum,
+            global_params,
+        )
+        bcast = {}
+        bcast_bits = jnp.float32(0.0)
+        for i, (k, v) in enumerate(new_global.items()):
+            vq, bits = qdq(
+                v.astype(jnp.float32), jax.random.fold_in(bcast_rng, i)
+            )
+            bcast[k] = vq.astype(v.dtype)
+            bcast_bits += bits * v.size
+        metrics = dict(metrics, bcast_bits=bcast_bits)
+        return new_global, bcast, opt_out, metrics
+
+    return round_program
+
+
+class SpmdFedOBDExpertParallelSession(SpmdFedOBDSession):
+    def __init__(
+        self,
+        config,
+        dataset_collection,
+        model_ctx,
+        engine: ComputeEngine,
+        practitioners,
+        expert_parallel: int,
+        codec: str = "nnadq",
+    ) -> None:
+        devices = jax.devices()
+        if expert_parallel > len(devices):
+            raise ValueError(
+                f"expert_parallel={expert_parallel} exceeds the "
+                f"{len(devices)}-device mesh"
+            )
+        kwargs = dict(getattr(config, "model_kwargs", {}) or {})
+        kwargs.pop("expert_parallel", None)
+        self._n_experts = int(kwargs.get("n_experts", 4))
+        if self._n_experts % expert_parallel:
+            raise ValueError(
+                f"expert_parallel={expert_parallel} must divide "
+                f"n_experts={self._n_experts}"
+            )
+        ep_mesh = Mesh(
+            np.asarray(devices[:expert_parallel]), axis_names=("ep",)
+        )
+        from ..models import create_model_context
+
+        kwargs["ep_axis"] = "ep"
+        ep_model_ctx = create_model_context(
+            config.model_name, dataset_collection, **kwargs
+        )
+        ep_model_ctx.compute_dtype = model_ctx.compute_dtype
+        self._ep_engine = ComputeEngine(
+            ep_model_ctx, engine.hyper_parameter, total_steps=engine.total_steps
+        )
+        super().__init__(
+            config, dataset_collection, model_ctx, engine, practitioners,
+            mesh=ep_mesh, codec=codec,
+        )
+        if not any(spec != P() for spec in self._param_specs.values()):
+            raise ValueError(
+                f"expert_parallel set but model {config.model_name!r} has no "
+                "expert-stacked kernels to shard (expected an MoE model, "
+                "e.g. MoETransformerClassificationModel)"
+            )
+
+    def _train_engine(self):
+        return self._ep_engine
+
+    def _leaf_spec(self, shape, name: str = "") -> P:
+        # same declaration-driven rule as SpmdExpertParallelSession
+        from ..models.moe import is_expert_param
+
+        leaf = jax.ShapeDtypeStruct(shape, jnp.float32)
+        if is_expert_param(name, leaf, self._n_experts):
+            return P("ep", None, None)
+        return P()
+
+    def _wrap_phase_program(self, local_train, qdq, phase_two: bool):
+        mesh = self.mesh
+        round_program = obd_scan_round_program(local_train, qdq, phase_two)
+        donate = (0, 1) if phase_two else (0,)
+        # pin the aggregate AND broadcast to the stored expert layout so
+        # donated round-over-round buffers never reshard
+        jitted = jax.jit(
+            round_program,
+            donate_argnums=donate,
+            out_shardings=(
+                self._param_shardings,
+                self._param_shardings,
+                None,
+                None,
+            ),
+        )
+
+        def fn(global_params, weights, rngs, bcast_rng, opt_state_s=None):
+            # bare-PartitionSpec constraints inside the MoE model resolve
+            # against the ambient mesh
+            with jax.sharding.set_mesh(mesh):
+                return jitted(
+                    global_params, opt_state_s, weights, rngs, bcast_rng,
+                    self._data,
+                )
+
+        return fn
+
+
+def build_obd_expert_parallel_session(ctx, session_args, codec: str):
+    model_kwargs = dict(ctx.config.model_kwargs)
+    return SpmdFedOBDExpertParallelSession(
+        *session_args,
+        expert_parallel=int(model_kwargs.get("expert_parallel", 0)),
+        codec=codec,
+    )

@@ -454,11 +454,16 @@ def resolve_executor(config) -> str:
             f"unknown executor {executor!r}; expected one of {_EXECUTORS}"
         )
     if int(dict(config.model_kwargs).get("expert_parallel", 0)):
-        if config.distributed_algorithm != "fed_avg":
+        if config.distributed_algorithm not in (
+            "fed_avg",
+            "fed_obd",
+            "fed_obd_sq",
+        ):
             raise ValueError(
                 "expert_parallel is implemented for fed_avg "
-                "(parallel/spmd_ep.py: the SPMD session gives the ep mesh "
-                "to each client's MoE model); drop the key for "
+                "(parallel/spmd_ep.py) and fed_obd/fed_obd_sq "
+                "(parallel/spmd_obd_ep.py: the SPMD session gives the ep "
+                "mesh to each client's MoE model); drop the key for "
                 f"{config.distributed_algorithm!r}"
             )
         if executor == "sequential":
@@ -486,9 +491,10 @@ def resolve_executor(config) -> str:
     if executor != "auto":
         return executor
     if int(dict(config.model_kwargs).get("sequence_parallel", 0)):
-        if config.distributed_algorithm == "fed_avg":
-            # dedicated SPMD session: the ("sp",) mesh shards each client's
+        if config.distributed_algorithm in ("fed_avg", "fed_obd", "fed_obd_sq"):
+            # dedicated SPMD sessions: the ("sp",) mesh shards each client's
             # sequence axis, clients scan inside one round program
+            # (parallel/spmd_sp.py; parallel/spmd_obd_sp.py for FedOBD)
             return "spmd"
         # other methods: the threaded executor, where each client's jitted
         # step owns the model's sp shard_map
@@ -530,8 +536,6 @@ def _make_spmd_session(ctx: TaskContext):
                 "layouts; set one (composing them is a mesh design choice "
                 "the YAML surface does not expose)"
             )
-        from .parallel.spmd_ep import build_expert_parallel_session
-
         session_args = (
             ctx.config,
             ctx.dataset_collection,
@@ -539,24 +543,57 @@ def _make_spmd_session(ctx: TaskContext):
             ctx.engine,
             ctx.practitioners,
         )
+        if ctx.config.distributed_algorithm in ("fed_obd", "fed_obd_sq"):
+            from .parallel.spmd_obd_ep import (
+                build_obd_expert_parallel_session,
+            )
+
+            codec = (
+                "qsgd"
+                if ctx.config.distributed_algorithm == "fed_obd_sq"
+                else "nnadq"
+            )
+            return build_obd_expert_parallel_session(
+                ctx, session_args, codec
+            )
+        from .parallel.spmd_ep import build_expert_parallel_session
+
         return build_expert_parallel_session(ctx, session_args, {})
     if int(dict(ctx.config.model_kwargs).get("sequence_parallel", 0)):
-        if ctx.config.distributed_algorithm != "fed_avg":
+        if ctx.config.distributed_algorithm not in (
+            "fed_avg",
+            "fed_obd",
+            "fed_obd_sq",
+        ):
             raise ValueError(
                 "sequence_parallel under executor=spmd is implemented for "
-                "fed_avg (parallel/spmd_sp.py); other methods run it on "
-                "the threaded executor, where each client's jitted step "
-                "owns the model's sp shard_map (executor auto does this)"
+                "fed_avg (parallel/spmd_sp.py) and fed_obd/fed_obd_sq "
+                "(parallel/spmd_obd_sp.py); other methods run it on the "
+                "threaded executor, where each client's jitted step owns "
+                "the model's sp shard_map (executor auto does this)"
+            )
+        session_args = (
+            ctx.config,
+            ctx.dataset_collection,
+            ctx.model_ctx,
+            ctx.engine,
+            ctx.practitioners,
+        )
+        if ctx.config.distributed_algorithm in ("fed_obd", "fed_obd_sq"):
+            from .parallel.spmd_obd_sp import (
+                build_obd_sequence_parallel_session,
+            )
+
+            codec = (
+                "qsgd"
+                if ctx.config.distributed_algorithm == "fed_obd_sq"
+                else "nnadq"
+            )
+            return build_obd_sequence_parallel_session(
+                ctx, session_args, codec
             )
         from .parallel.spmd_sp import build_sequence_parallel_session
 
-        session_args = (
-            ctx.config,
-            ctx.dataset_collection,
-            ctx.model_ctx,
-            ctx.engine,
-            ctx.practitioners,
-        )
         return build_sequence_parallel_session(ctx, session_args, {})
     builder = SPMD_SESSION_BUILDERS.get(ctx.config.distributed_algorithm)
     if builder is None:

@@ -46,6 +46,14 @@ def _final_stats(server_dir: str) -> dict:
     }
 
 
+#: which session/<algorithm>/ trees a script's runs land in — other
+#: concurrent sessions (tests, benches) must not leak into the evidence
+SCRIPT_ALGOS = {
+    "gtg_shapley_train.sh": ("GTG_shapley_value",),
+    "fed_obd_train.sh": ("fed_obd",),
+}
+
+
 def run_script(script: str) -> dict:
     before = _sessions()
     start = time.monotonic()
@@ -53,7 +61,14 @@ def run_script(script: str) -> dict:
         ["bash", script], cwd=REPO, capture_output=True, text=True
     )
     wall = time.monotonic() - start
-    runs = [_final_stats(d) for d in sorted(_sessions() - before)]
+    algos = SCRIPT_ALGOS.get(script)
+    new = sorted(_sessions() - before)
+    if algos is not None:
+        prefixes = tuple(
+            os.path.join(SESSION_DIR, algo) + os.sep for algo in algos
+        )
+        new = [d for d in new if d.startswith(prefixes)]
+    runs = [_final_stats(d) for d in new]
     entry = {
         "wall_seconds": round(wall, 1),
         "returncode": proc.returncode,
