@@ -161,6 +161,10 @@ class Trainer(ExecutorBase):
         self._rng = jax.random.PRNGKey(self._seed + 0x5EED)
         self._epoch_counter = 0  # cumulative epochs across rounds
         self._round_stream = None  # SPMD-aligned rng for the next round
+        #: the quant rng the aligned stream reserved this round (the key
+        #: the SPMD local_train hands its in-program codec) — a worker
+        #: passes it to its quantized endpoint for codec parity (fed_paq)
+        self.reserved_quant_rng = None
         self.batch_loss_log_enabled = True
 
     def set_round_stream(self, rng) -> None:
@@ -226,9 +230,11 @@ class Trainer(ExecutorBase):
         self._fire(ExecutorHookPoint.BEFORE_EXECUTE)
         per_step = any(self.has_hook(p) for p in _PER_STEP_POINTS)
         aligned, self._round_stream = self._round_stream, None
+        self.reserved_quant_rng = None
         if aligned is not None:
-            train_rng, _quant = jax.random.split(aligned)
+            train_rng, quant_rng = jax.random.split(aligned)
             aligned_epoch_rngs = jax.random.split(train_rng, hp.epoch)
+            self.reserved_quant_rng = quant_rng
         try:
             for epoch in range(1, hp.epoch + 1):
                 start = time.monotonic()

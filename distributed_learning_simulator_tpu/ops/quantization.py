@@ -130,18 +130,31 @@ def stochastic_quantization(quantization_level: int = 255, use_pallas: bool | No
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
 
-    def quant(tree: Any, seed: int = 0) -> dict:
+    def quant(tree: Any, seed: int = 0, key=None) -> dict:
+        """``key`` (a jax PRNGKey) overrides the integer seed: per-leaf
+        keys come from ``split(key, n_leaves)`` — EXACTLY the stream the
+        SPMD in-program codec draws (``parallel/spmd.py`` local_train),
+        which is what cross-executor fed_paq codec parity needs.  The
+        pallas packer has its own integer-seed rng, so the key path pins
+        the XLA leaf encoder."""
         from . import pallas_kernels as pk
 
         leaves, treedef = jax.tree.flatten(tree)
-        keys = jax.random.split(jax.random.PRNGKey(seed), max(1, len(leaves)))
+        if key is not None:
+            keys = jax.random.split(key, max(1, len(leaves)))
+        else:
+            keys = jax.random.split(
+                jax.random.PRNGKey(seed), max(1, len(leaves))
+            )
         encoded = []
-        for i, (leaf, key) in enumerate(zip(leaves, keys)):
+        for i, (leaf, key_i) in enumerate(zip(leaves, keys)):
             leaf = jnp.asarray(leaf)
             # the pallas packer pads each leaf to whole (32, 128) tiles
             # (worst case 4096 elements) — only worth it for leaves where
             # that padding is noise (<~6%)
-            leaf_pallas = use_pallas and leaf.size >= 16 * 32 * 128
+            leaf_pallas = (
+                key is None and use_pallas and leaf.size >= 16 * 32 * 128
+            )
             if leaf_pallas:
                 packed, packed_signs, scale = pk.qsgd_encode(
                     leaf,
@@ -151,7 +164,7 @@ def stochastic_quantization(quantization_level: int = 255, use_pallas: bool | No
                 )
             else:
                 packed, packed_signs, scale = _sq_encode_leaf(
-                    leaf, key, quantization_level, bits
+                    leaf, key_i, quantization_level, bits
                 )
             encoded.append(
                 {

@@ -36,27 +36,30 @@ from ..utils.logging import get_logger
 from .mesh import client_slots, make_mesh, put_sharded
 
 
-def stack_client_data(config, dataset_collection, practitioners, n_slots):
-    """Stack per-client training data to ``[C, n_batches, B, ...]`` with
-    zero-weight padding slots; returns (data dict, dataset_sizes, n_batches)."""
-    train = dataset_collection.get_dataset(Phase.Training)
-    batch_size = config.batch_size
-    sizes = []
-    per_client_indices = []
+def _client_phase_indices(config, practitioners, phase):
+    """Worker-ordered per-client index arrays for one dataset phase."""
+    indices = []
     for practitioner in sorted(practitioners, key=lambda p: p.worker_id):
-        sampler = practitioner.get_sampler(config.dataset_name)
-        idx = sampler.sample(practitioner.practitioner_id)[Phase.Training]
-        per_client_indices.append(idx)
-        sizes.append(len(idx))
-    max_size = max(sizes)
+        sampled = practitioner.get_sampler(config.dataset_name).sample(
+            practitioner.practitioner_id
+        )
+        indices.append(np.asarray(sampled.get(phase, []), np.int64))
+    return indices
+
+
+def _stack_slot_batches(dataset, per_client_indices, n_slots, batch_size):
+    """THE slot-stacking contract, shared by the training and validation
+    stacks: pad every client's index set to ``n_batches × batch_size``
+    (mask 0 on padding), add zero-weight padding slots up to ``n_slots``,
+    and reshape to ``[C, n_batches, B, ...]``.  Returns (data, n_batches)."""
+    max_size = max((len(i) for i in per_client_indices), default=0)
     n_batches = max(1, (max_size + batch_size - 1) // batch_size)
     slot_size = n_batches * batch_size
-
     inputs, targets, masks = [], [], []
     for idx in per_client_indices:
         padded, mask = fixed_size_partition(idx, slot_size)
-        inputs.append(train.inputs[padded])
-        targets.append(train.targets[padded])
+        inputs.append(dataset.inputs[padded])
+        targets.append(dataset.targets[padded])
         masks.append(mask)
     while len(inputs) < n_slots:  # zero-weight padding slots
         inputs.append(np.zeros_like(inputs[0]))
@@ -64,15 +67,55 @@ def stack_client_data(config, dataset_collection, practitioners, n_slots):
         masks.append(np.zeros_like(masks[0]))
 
     def stack(parts, extra_shape):
-        return np.stack(parts).reshape(n_slots, n_batches, batch_size, *extra_shape)
+        return np.stack(parts).reshape(
+            n_slots, n_batches, batch_size, *extra_shape
+        )
 
     data = {
-        "input": stack(inputs, train.inputs.shape[1:]),
+        "input": stack(inputs, dataset.inputs.shape[1:]),
         "target": stack(targets, ()),
         "mask": stack(masks, ()),
     }
+    return data, n_batches
+
+
+def stack_client_data(config, dataset_collection, practitioners, n_slots):
+    """Stack per-client training data to ``[C, n_batches, B, ...]`` with
+    zero-weight padding slots; returns (data dict, dataset_sizes, n_batches)."""
+    train = dataset_collection.get_dataset(Phase.Training)
+    per_client_indices = _client_phase_indices(
+        config, practitioners, Phase.Training
+    )
+    sizes = [len(idx) for idx in per_client_indices]
+    data, n_batches = _stack_slot_batches(
+        train, per_client_indices, n_slots, config.batch_size
+    )
     dataset_sizes = np.asarray(sizes + [0] * (n_slots - len(sizes)), np.float32)
     return data, dataset_sizes, n_batches
+
+
+def stack_client_val_data(config, dataset_collection, practitioners, n_slots):
+    """Per-client VALIDATION batches ``[C, n_batches, B, ...]`` (or None
+    when the phase is absent/empty) — the in-program substrate for the
+    reference's iid ``choose_model_by_validation`` upload policy
+    (``worker/aggregation_worker.py::KeepModelHook``).  Clients whose val
+    split is empty get all-masked batches: their accuracy ties at 0 every
+    epoch and the ``>=`` keep rule picks the final epoch, matching the
+    threaded worker's per-worker disable."""
+    if not dataset_collection.has_dataset(Phase.Validation):
+        return None
+    val = dataset_collection.get_dataset(Phase.Validation)
+    if int(np.asarray(val.inputs).shape[0]) == 0:
+        return None
+    per_client_indices = _client_phase_indices(
+        config, practitioners, Phase.Validation
+    )
+    if max((len(i) for i in per_client_indices), default=0) == 0:
+        return None
+    data, _ = _stack_slot_batches(
+        val, per_client_indices, n_slots, config.batch_size
+    )
+    return data
 
 
 def shard_map_compat(fn, mesh, in_specs, out_specs):
@@ -99,38 +142,76 @@ from ..ops.quantization import qsgd_quantize_dequantize as qsgd_dequantized
 
 
 def scan_local_epochs(
-    engine, epochs: int, global_params, data, rng, opt_state=None
+    engine, epochs: int, global_params, data, rng, opt_state=None,
+    val_data=None,
 ):
     """One client's local training: ``epochs`` of minibatch SGD from the
     fresh global params, optimizer rebuilt (AggregationWorker semantics,
     ``util/model.py:6-23``) unless ``opt_state`` is given
     (``reuse_learning_rate`` continuation — FedOBD phase 2).  Returns
-    (params, summed metrics).  Shared by every SPMD session's local-train
-    body; use :func:`scan_local_epochs_carry` to also get the final
-    optimizer state back."""
+    (params, summed metrics).  With ``val_data`` (the iid
+    ``choose_model_by_validation`` policy — KeepModelHook semantics,
+    reference ``aggregation_worker.py:33-44``), the returned params are
+    the round's BEST epoch by validation accuracy (``>=``: later epoch
+    wins ties), not the final ones.  Shared by every SPMD session's
+    local-train body; use :func:`scan_local_epochs_carry` to also get
+    the final optimizer state back."""
     params, _, metrics = scan_local_epochs_carry(
-        engine, epochs, global_params, data, rng, opt_state
+        engine, epochs, global_params, data, rng, opt_state, val_data
     )
     return params, metrics
 
 
 def scan_local_epochs_carry(
-    engine, epochs: int, global_params, data, rng, opt_state=None
+    engine, epochs: int, global_params, data, rng, opt_state=None,
+    val_data=None,
 ):
     if opt_state is None:
         opt_state = engine.optimizer.init(global_params)
+    epoch_rngs = jax.random.split(rng, epochs)
+
+    if val_data is None:
+
+        def epoch_body(carry, epoch_rng):
+            params, opt_state = carry
+            params, opt_state, metrics = engine.train_epoch_fn(
+                params, opt_state, data, epoch_rng
+            )
+            return (params, opt_state), metrics
+
+        (params, opt_state), metrics = jax.lax.scan(
+            epoch_body, (global_params, opt_state), epoch_rngs
+        )
+        return params, opt_state, jax.tree.map(lambda x: jnp.sum(x), metrics)
 
     def epoch_body(carry, epoch_rng):
-        params, opt_state = carry
+        params, opt_state, best_params, best_acc = carry
         params, opt_state, metrics = engine.train_epoch_fn(
             params, opt_state, data, epoch_rng
         )
-        return (params, opt_state), metrics
+        summed = engine.eval_fn(params, val_data)
+        acc = summed["correct"] / jnp.maximum(summed["count"], 1.0)
+        better = acc >= best_acc
+        best_params = jax.tree.map(
+            lambda b, p: jnp.where(better, p, b), best_params, params
+        )
+        return (
+            params,
+            opt_state,
+            best_params,
+            jnp.where(better, acc, best_acc),
+        ), metrics
 
-    (params, opt_state), metrics = jax.lax.scan(
-        epoch_body, (global_params, opt_state), jax.random.split(rng, epochs)
+    (params, opt_state, best_params, _), metrics = jax.lax.scan(
+        epoch_body,
+        (global_params, opt_state, global_params, jnp.float32(-1.0)),
+        epoch_rngs,
     )
-    return params, opt_state, jax.tree.map(lambda x: jnp.sum(x), metrics)
+    return (
+        best_params,
+        opt_state,
+        jax.tree.map(lambda x: jnp.sum(x), metrics),
+    )
 
 
 def whole_mesh_session_shapes(session):
@@ -164,6 +245,7 @@ def scan_weighted_clients(
     weights,
     rngs,
     metrics_shape,
+    val_data=None,
 ):
     """Clients one after another as a ``lax.scan`` (the round body of the
     whole-mesh-per-client sessions, ``spmd_sp.py``/``spmd_ep.py``), with
@@ -174,10 +256,11 @@ def scan_weighted_clients(
     uniform program.  Returns (weighted-average params, summed metrics)."""
 
     def body(acc, xs):
-        cdata, weight, rng = xs
+        cdata, cval, weight, rng = xs
         rng, _ = jax.random.split(rng)
         params, summed = scan_local_epochs(
-            engine, epochs, global_params, cdata, rng
+            engine, epochs, global_params, cdata, rng,
+            val_data=cval if cval else None,
         )
         acc_params, acc_metrics = acc
         acc_params = jax.tree.map(
@@ -201,7 +284,9 @@ def scan_weighted_clients(
         lambda s: jnp.zeros(s.shape, s.dtype), metrics_shape
     )
     (acc_params, metrics), _ = jax.lax.scan(
-        body, (zero_params, zero_metrics), (data, weights, rngs)
+        body,
+        (zero_params, zero_metrics),
+        (data, val_data if val_data else {}, weights, rngs),
     )
     total = jnp.maximum(jnp.sum(weights), 1e-12)
     new_global = jax.tree.map(
@@ -218,6 +303,11 @@ class SpmdFedAvgSession:
     fed_paq (``quantization_level`` set: client uploads pass through QSGD
     quantize→dequantize before the weighted psum).
     """
+
+    #: whether this session's round program consumes ``_val_data`` (the
+    #: iid best-of-round upload policy) — subclasses with their own round
+    #: programs that ignore it opt out so __init__ skips the stack+put
+    _uses_val_policy = True
 
     def __init__(
         self,
@@ -307,6 +397,27 @@ class SpmdFedAvgSession:
             self._data, NamedSharding(self.mesh, self._slot_spec)
         )
 
+        # iid upload policy (reference ``enable_choose_model_by_validation``,
+        # ``aggregation_worker.py:33-44``): clients upload their round's
+        # best epoch by validation accuracy — the SPMD program needs the
+        # per-client validation batches in-program for that.  Skipped when
+        # a single epoch makes best == final (the in-round val eval is a
+        # full extra forward per client), and for subclasses whose round
+        # programs do not consume it (OBD/sparse/Shapley).
+        self._val_data = None
+        if (
+            self._uses_val_policy
+            and config.dataset_sampling == "iid"
+            and config.epoch > 1
+        ):
+            val = stack_client_val_data(
+                config, dataset_collection, practitioners, self.n_slots
+            )
+            if val is not None:
+                self._val_data = put_sharded(
+                    val, NamedSharding(self.mesh, self._slot_spec)
+                )
+
         self._round_fn = self._build_round_fn()
 
     def _leaf_spec(self, shape, name: str = "") -> P:
@@ -342,10 +453,12 @@ class SpmdFedAvgSession:
         epochs = self.config.epoch
         quant_level = self.quantization_level
 
-        def local_train(global_params, data, weight, rng):
+        def local_train(global_params, data, weight, rng, val=None):
             """One client slot's round contribution."""
             rng, quant_rng = jax.random.split(rng)
-            params, summed = scan_local_epochs(engine, epochs, global_params, data, rng)
+            params, summed = scan_local_epochs(
+                engine, epochs, global_params, data, rng, val_data=val
+            )
             if quant_level is not None:
                 # fed_paq: the upload delta goes through the stochastic
                 # codec before aggregation sees it
@@ -377,13 +490,15 @@ class SpmdFedAvgSession:
                 mb -= 1
             return mb
 
-        def round_program(global_params, weights, rngs, data):
+        def round_program(global_params, weights, rngs, data, val):
             """shard_map body: scan client chunks, vmap inside each, psum
             the reduction.  ``data`` is an explicit argument — closing over
             the stacked client arrays would bake them into the HLO as
-            constants (hundreds of MB of program, slow/oversized compiles)."""
+            constants (hundreds of MB of program, slow/oversized compiles).
+            ``val`` is the per-client validation stack for the iid
+            best-of-round upload policy, or ``{}`` (no leaves) when off."""
 
-            def shard_body(global_params, data, weights, rngs):
+            def shard_body(global_params, data, val, weights, rngs):
                 params_in = global_params  # per-device (possibly sharded) view
                 if self._fsdp:
                     # materialize full params for local training; XLA frees
@@ -396,10 +511,16 @@ class SpmdFedAvgSession:
                     }
                 slots_local = weights.shape[0]
                 mb = chunk_size(slots_local)
+
+                def run_slots(d, w, r, v):
+                    return jax.vmap(
+                        local_train, in_axes=(None, 0, 0, 0, 0)
+                    )(global_params, d, w, r, v if v else None)
+
                 if mb == slots_local:
-                    contributions, metrics = jax.vmap(
-                        local_train, in_axes=(None, 0, 0, 0)
-                    )(global_params, data, weights, rngs)
+                    contributions, metrics = run_slots(
+                        data, weights, rngs, val
+                    )
                     local_sum = jax.tree.map(
                         lambda c: jnp.sum(c, axis=0), contributions
                     )
@@ -413,10 +534,8 @@ class SpmdFedAvgSession:
                         )
 
                     def chunk_body(acc, chunk):
-                        data_k, w_k, r_k = chunk
-                        contrib, met = jax.vmap(
-                            local_train, in_axes=(None, 0, 0, 0)
-                        )(global_params, data_k, w_k, r_k)
+                        data_k, v_k, w_k, r_k = chunk
+                        contrib, met = run_slots(data_k, w_k, r_k, v_k)
                         acc_sum, acc_met = acc
                         acc_sum = jax.tree.map(
                             lambda a, c: a + jnp.sum(c, axis=0), acc_sum, contrib
@@ -426,13 +545,16 @@ class SpmdFedAvgSession:
                         )
                         return (acc_sum, acc_met), None
 
-                    chunks = (to_chunks(data), to_chunks(weights), to_chunks(rngs))
+                    chunks = (
+                        to_chunks(data),
+                        to_chunks(val),
+                        to_chunks(weights),
+                        to_chunks(rngs),
+                    )
                     # metric accumulator structure comes from the train fn
                     # itself (trace-time eval_shape), not hardcoded keys
                     _, met_shapes = jax.eval_shape(
-                        lambda d, w, r: jax.vmap(
-                            local_train, in_axes=(None, 0, 0, 0)
-                        )(global_params, d, w, r),
+                        lambda d, v, w, r: run_slots(d, w, r, v),
                         *jax.tree.map(lambda x: x[0], chunks),
                     )
                     init = (
@@ -481,16 +603,19 @@ class SpmdFedAvgSession:
                     self._slot_spec,
                     self._slot_spec,
                     self._slot_spec,
+                    self._slot_spec,
                 ),
                 out_specs=(self._param_specs, P()),
-            )(global_params, data, weights, rngs)
+            )(global_params, data, val, weights, rngs)
 
         # donate the old global params: the round returns the new ones, so
         # XLA can reuse the buffer instead of holding both copies live
         jitted = jax.jit(round_program, donate_argnums=(0,))
 
         def fn(global_params, weights, rngs):
-            return jitted(global_params, weights, rngs, self._data)
+            return jitted(
+                global_params, weights, rngs, self._data, self._val_data or {}
+            )
 
         return fn
 

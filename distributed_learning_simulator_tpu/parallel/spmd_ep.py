@@ -103,10 +103,10 @@ class SpmdExpertParallelSession(SpmdFedAvgSession):
         mesh = self.mesh
         _, metrics_shape = whole_mesh_session_shapes(self)
 
-        def round_program(global_params, weights, rngs, data):
+        def round_program(global_params, weights, rngs, data, val):
             return scan_weighted_clients(
                 engine, epochs, global_params, data, weights, rngs,
-                metrics_shape,
+                metrics_shape, val_data=val if val else None,
             )
 
         # out_shardings pin the new globals to the stored expert layout so
@@ -121,7 +121,10 @@ class SpmdExpertParallelSession(SpmdFedAvgSession):
             # bare-PartitionSpec sharding constraints inside the MoE model
             # resolve against the ambient mesh
             with jax.sharding.set_mesh(mesh):
-                return jitted(global_params, weights, rngs, self._data)
+                return jitted(
+                    global_params, weights, rngs, self._data,
+                    self._val_data or {},
+                )
 
         return fn
 

@@ -54,6 +54,8 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
     (fed_obd) or ``"qsgd"`` (fed_obd_sq, reference
     ``method/fed_obd/__init__.py:16-22``)."""
 
+    _uses_val_policy = False  # own round program; no val policy
+
     def __init__(self, *args, codec: str = "nnadq", **kwargs) -> None:
         self._phase2_fn = None
         self._codec = codec

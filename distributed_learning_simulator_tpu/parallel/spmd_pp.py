@@ -128,27 +128,29 @@ class SpmdPipelineSession(SingleDeviceEvalMixin, SpmdFedAvgSession):
         _, metrics_shape = whole_mesh_session_shapes(self)
         param_specs = self._param_specs
 
-        def round_program(global_params, weights, rngs, data):
-            def shard_body(global_params, data, weights, rngs):
+        def round_program(global_params, weights, rngs, data, val):
+            def shard_body(global_params, data, val, weights, rngs):
                 # trunk leaves here are LOCAL stage slices; data/weights/
                 # rngs replicated (every stage sees the full batch — the
                 # schedule's stage-0 select feeds it into the pipe)
                 return scan_weighted_clients(
                     engine, epochs, global_params, data, weights, rngs,
-                    metrics_shape,
+                    metrics_shape, val_data=val if val else None,
                 )
 
             return shard_map_compat(
                 shard_body,
                 mesh,
-                in_specs=(param_specs, P(), P(), P()),
+                in_specs=(param_specs, P(), P(), P(), P()),
                 out_specs=(param_specs, P()),
-            )(global_params, data, weights, rngs)
+            )(global_params, data, val, weights, rngs)
 
         jitted = jax.jit(round_program, donate_argnums=(0,))
 
         def fn(global_params, weights, rngs):
-            return jitted(global_params, weights, rngs, self._data)
+            return jitted(
+                global_params, weights, rngs, self._data, self._val_data or {}
+            )
 
         return fn
 

@@ -37,6 +37,8 @@ ENGINE_FOR = {
 
 
 class SpmdShapleySession(SpmdFedAvgSession):
+    _uses_val_policy = False  # own round program; no val policy
+
     def __init__(self, *args, **kwargs) -> None:
         super().__init__(*args, **kwargs)
         from .. import shapley

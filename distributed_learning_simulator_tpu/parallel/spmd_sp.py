@@ -150,32 +150,36 @@ class SpmdSequenceParallelSession(SingleDeviceEvalMixin, SpmdFedAvgSession):
         mesh = self.mesh
         _, metrics_shape = whole_mesh_session_shapes(self)
 
-        def round_program(global_params, weights, rngs, data):
-            def shard_body(global_params, data, weights, rngs):
+        def round_program(global_params, weights, rngs, data, val):
+            def shard_body(global_params, data, val, weights, rngs):
                 # data leaves here are LOCAL sequence blocks ([C, nb, B, L/sp]
                 # for the token input); params/weights/rngs are replicated
                 return scan_weighted_clients(
                     engine, epochs, global_params, data, weights, rngs,
-                    metrics_shape,
+                    metrics_shape, val_data=val if val else None,
                 )
 
-            data_specs = jax.tree.map(
-                lambda x: P(None, None, None, "sp")
-                if x.ndim >= 4
-                else P(),
-                data,
-            )
+            def seq_specs(tree):
+                return jax.tree.map(
+                    lambda x: P(None, None, None, "sp")
+                    if x.ndim >= 4
+                    else P(),
+                    tree,
+                )
+
             return shard_map_compat(
                 shard_body,
                 mesh,
-                in_specs=(P(), data_specs, P(), P()),
+                in_specs=(P(), seq_specs(data), seq_specs(val), P(), P()),
                 out_specs=(P(), P()),
-            )(global_params, data, weights, rngs)
+            )(global_params, data, val, weights, rngs)
 
         jitted = jax.jit(round_program, donate_argnums=(0,))
 
         def fn(global_params, weights, rngs):
-            return jitted(global_params, weights, rngs, self._data)
+            return jitted(
+                global_params, weights, rngs, self._data, self._val_data or {}
+            )
 
         return fn
 

@@ -29,6 +29,8 @@ from .spmd import SpmdFedAvgSession, scan_local_epochs, shard_map_compat
 
 
 class SpmdFedDropoutAvgSession(SpmdFedAvgSession):
+    _uses_val_policy = False  # own round program; no val policy
+
     def _upload_cost_factor(self) -> float:
         return 1.0 - float(self.config.algorithm_kwargs["dropout_rate"])
 
@@ -116,6 +118,8 @@ class SpmdSMAFDSession(SpmdFedAvgSession):
     ``simulation_lib/worker/error_feedback_worker.py:9-19``).  The file is
     worker_number × model-size; a missing/mismatched file degrades to a
     zero restart with a loud warning rather than failing the resume."""
+
+    _uses_val_policy = False  # own round program; no val policy
 
     def _err_path(self, base_dir: str) -> str:
         import os

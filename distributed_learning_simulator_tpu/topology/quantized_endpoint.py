@@ -141,8 +141,18 @@ class StochasticQuantClientEndpoint(QuantClientEndpoint):
     def __init__(self, topology, worker_id, quantization_level: int = 255, **kwargs):
         super().__init__(topology, worker_id, **kwargs)
         self._q, self._dq = stochastic_quantization(quantization_level)
+        self._pending_key = None
+
+    def set_quant_key(self, key) -> None:
+        """One-shot PRNGKey for the next encode — the worker hands over
+        its round's reserved quant rng so the wire distortion matches the
+        SPMD in-program codec (cross-executor fed_paq parity)."""
+        self._pending_key = key
 
     def _quant(self, tree):
+        key, self._pending_key = self._pending_key, None
+        if key is not None:
+            return self._q(tree, key=key)
         self._quant_seed += 1
         return self._q(tree, seed=self._quant_seed * 2 + self.worker_id)
 

@@ -90,7 +90,10 @@ class AggregationWorker(Client):
         per-step exchanges, OBD phase logic), so stream alignment alone
         cannot make them bit-comparable — see PARITY.md."""
         super()._before_round()
-        if self.config.distributed_algorithm == "fed_avg":
+        if self.config.distributed_algorithm in ("fed_avg", "fed_paq"):
+            # fed_paq = fed_avg + the stochastic codec; the aligned stream
+            # ALSO reserves the quant rng, which _aggregation hands to the
+            # endpoint so the wire distortion matches the SPMD program's
             from ..engine.executor import aligned_round_stream
 
             self.trainer.set_round_stream(
@@ -110,6 +113,11 @@ class AggregationWorker(Client):
         )
 
     def _aggregation(self, sent_data: Message, **kwargs: Any) -> None:
+        quant_key = getattr(self.trainer, "reserved_quant_rng", None)
+        if quant_key is not None and hasattr(self._endpoint, "set_quant_key"):
+            # codec parity with the SPMD in-program path (fed_paq): the
+            # endpoint's next encode draws the reserved per-round key
+            self._endpoint.set_quant_key(quant_key)
         self.send_data_to_server(sent_data)
         self._offload_from_device()
         self._get_result_from_server()

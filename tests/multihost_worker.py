@@ -45,10 +45,12 @@ def main() -> int:
     from distributed_learning_simulator_tpu.models import create_model_context
     from distributed_learning_simulator_tpu.parallel.spmd import SpmdFedAvgSession
 
-    if mode in ("obd", "gnn", "shapley"):
+    if mode in ("obd", "gnn", "shapley", "sign_sgd", "smafd"):
         # the full product path: train() builds the session over the
         # 8-device global mesh; collectives (psum'd embedding tables, OBD
-        # phase programs, SV subset evaluations) cross the process boundary
+        # phase programs, SV subset evaluations, sign-SGD's per-step
+        # majority-vote psum, smafd's client-sharded residual state)
+        # cross the process boundary
         return run_method_mode(mode, process_id, save_dir)
 
     fsdp = mode == "fsdp"
@@ -150,6 +152,40 @@ def method_config(mode: str, save_dir: str):
             learning_rate=0.01,
             **common,
         )
+    if mode == "sign_sgd":
+        # the most communication-intensive pattern in the framework: one
+        # majority-vote psum per OPTIMIZER STEP, all inside the scanned
+        # run program — per-step collectives cross the process boundary
+        return DistributedTrainingConfig(
+            dataset_name="MNIST",
+            model_name="LeNet5",
+            distributed_algorithm="sign_SGD",
+            worker_number=8,
+            batch_size=16,
+            round=2,
+            epoch=1,
+            learning_rate=0.05,
+            distribute_init_parameters=False,
+            dataset_kwargs={"train_size": 128, "val_size": 16, "test_size": 32},
+            **common,
+        )
+    if mode == "smafd":
+        # device-resident error-feedback residual state, P("clients")-
+        # sharded ACROSS HOSTS, checkpointed per round (err_state.npz via
+        # the replicated reshard) and folded into the digest
+        return DistributedTrainingConfig(
+            dataset_name="MNIST",
+            model_name="LeNet5",
+            distributed_algorithm="single_model_afd",
+            worker_number=8,
+            batch_size=16,
+            round=2,
+            epoch=1,
+            learning_rate=0.05,
+            algorithm_kwargs={"dropout_rate": 0.3},
+            dataset_kwargs={"train_size": 128, "val_size": 16, "test_size": 32},
+            **common,
+        )
     assert mode == "shapley", mode
     return DistributedTrainingConfig(
         dataset_name="MNIST",
@@ -163,6 +199,25 @@ def method_config(mode: str, save_dir: str):
         dataset_kwargs={"train_size": 96, "val_size": 16, "test_size": 32},
         **common,
     )
+
+
+def artifact_paths(mode: str, save_dir: str, result: dict) -> list[str]:
+    """Which npz artifacts a mode's digest covers — shared with the
+    test's single-process comparison so the two cannot drift.  sign_SGD's
+    session keeps params in-program and writes only the best-model
+    artifact; smafd additionally proves its client-sharded residual state
+    survived the cross-host checkpoint reshard."""
+    last = max(result["performance"])
+    if mode == "sign_sgd":
+        return [os.path.join(save_dir, "server", "best_global_model.npz")]
+    paths = [
+        os.path.join(save_dir, "aggregated_model", f"round_{last}.npz")
+    ]
+    if mode == "smafd":
+        paths.append(
+            os.path.join(save_dir, "aggregated_model", "err_state.npz")
+        )
+    return paths
 
 
 def run_method_mode(mode: str, process_id: int, save_dir: str) -> int:
@@ -180,15 +235,12 @@ def run_method_mode(mode: str, process_id: int, save_dir: str) -> int:
     stat = result["performance"][max(result["performance"])]
     assert 0.0 <= stat["test_accuracy"] <= 1.0, stat
 
-    rounds = sorted(result["performance"])
-    npz_path = os.path.join(
-        config.save_dir, "aggregated_model", f"round_{rounds[-1]}.npz"
-    )
-    blob = np.load(npz_path)
     hasher = hashlib.sha256()
-    for key in sorted(blob.files):
-        hasher.update(key.encode())
-        hasher.update(np.ascontiguousarray(blob[key]).tobytes())
+    for npz_path in artifact_paths(mode, config.save_dir, result):
+        blob = np.load(npz_path)
+        for key in sorted(blob.files):
+            hasher.update(key.encode())
+            hasher.update(np.ascontiguousarray(blob[key]).tobytes())
     if mode == "shapley":
         # the SV values are part of the artifact contract
         sv = result.get("sv", {})

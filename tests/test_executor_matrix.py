@@ -116,24 +116,20 @@ def test_fed_avg_executors_match_tightly(sampling, tmp_session_dir):
     advance a shorter threaded epoch wouldn't have), and the host-f64
     FedAVG aggregation matches the psum to ≤1e-6/leaf (test_fedavg_parity)
     — so two rounds of two epochs end within float accumulation order even
-    with UNEVEN client sizes (random_label_iid).  Other methods stay loose
-    (test_both_executors_agree): their extra rng consumers live in
-    different places on the two executors (endpoint codecs vs in-program
-    QSGD, per-step sign exchanges, OBD phase logic) — see PARITY.md.
+    with UNEVEN client sizes (random_label_iid).
 
-    iid runs epoch=1: at epoch>1 the threaded worker uploads its
-    best-of-round epoch by validation (reference iid semantics,
-    ``enable_choose_model_by_validation``) while the SPMD program uploads
-    final params — a policy difference, not drift."""
-
-    epoch = 1 if sampling == "iid" else 2
+    Under ``iid`` the threaded worker uploads its best-of-round epoch by
+    validation (reference semantics, ``enable_choose_model_by_validation``)
+    — since round 5 the SPMD program implements the SAME policy in-program
+    (``scan_local_epochs`` with the stacked per-client validation
+    batches), so iid is tight at epoch=2 too (VERDICT r4 item 4)."""
 
     def run(executor: str) -> dict:
         config = DistributedTrainingConfig(
             distributed_algorithm="fed_avg",
             executor=executor,
             dataset_sampling=sampling,
-            **dict(VISION, round=2, epoch=epoch),
+            **dict(VISION, round=2, epoch=2),
         )
         return train(config)
 
@@ -145,6 +141,62 @@ def test_fed_avg_executors_match_tightly(sampling, tmp_session_dir):
     assert threaded_stat["test_accuracy"] == pytest.approx(
         spmd_stat["test_accuracy"], abs=1e-6
     )
+
+
+def test_fed_paq_executors_match_tightly(tmp_session_dir):
+    """fed_paq = fed_avg + the QSGD wire codec; the one remaining stream
+    gap was codec-rng PLACEMENT (endpoint integer seeds vs the in-program
+    split) — closed by reserving the round's quant rng in the aligned
+    stream and handing it to the endpoint (``set_quant_key``), so the
+    wire distortion is identical and the trajectory is tight (VERDICT r4
+    item 4)."""
+
+    def run(executor: str) -> dict:
+        config = DistributedTrainingConfig(
+            distributed_algorithm="fed_paq",
+            executor=executor,
+            dataset_sampling="iid",
+            endpoint_kwargs={"worker": {"quantization_level": 255}},
+            **dict(VISION, round=2, epoch=1),
+        )
+        return train(config)
+
+    spmd_stat = _final_stat(run("spmd"))
+    threaded_stat = _final_stat(run("sequential"))
+    np.testing.assert_allclose(
+        threaded_stat["test_loss"], spmd_stat["test_loss"], rtol=0, atol=1e-5
+    )
+    assert threaded_stat["test_accuracy"] == pytest.approx(
+        spmd_stat["test_accuracy"], abs=1e-6
+    )
+
+
+#: why each non-tight method remains loosely compared (VERDICT r4 item 4:
+#: "remaining loose methods each carry a one-line reason")
+LOOSE_REASONS = {
+    "sign_SGD": "per-optimizer-step sign exchange: the threaded path draws "
+    "per-step rngs in the gradient worker, SPMD in one whole-run program",
+    "fed_obd": "phase driver + block selection consume extra draws at "
+    "different points; NNADQ is deterministic but phase-2 epochs re-batch",
+    "fed_obd_sq": "as fed_obd, with the QSGD codec seeded per phase program",
+    "fed_dropout_avg": "per-element Bernoulli mask rngs live in the server "
+    "algorithm on the threaded path, in-program on SPMD",
+    "single_model_afd": "error-feedback residual + top-k tie ordering "
+    "(documented drift bound, test_smafd_topk_drift)",
+    "GTG_shapley_value": "SV subset evaluation order differs (batched "
+    "device stack vs sequential inference)",
+    "multiround_shapley_value": "as GTG: batched subset metrics",
+    "Hierarchical_shapley_value": "as GTG, plus two-level grouping",
+    "fed_gnn": "neighbor-sampling rngs drawn in the loader on the "
+    "threaded path, in-program on SPMD",
+    "fed_gcn": "as fed_gnn",
+    "fed_aas": "per-round resampled fan-in masks use loader rngs",
+}
+
+
+def test_loose_reasons_cover_exactly_the_loose_methods():
+    tight = {"fed_avg", "fed_paq"}
+    assert set(LOOSE_REASONS) == set(MATRIX) - tight
 
 
 @pytest.mark.parametrize("method", sorted(MATRIX))
