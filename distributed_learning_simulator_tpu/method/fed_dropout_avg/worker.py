@@ -25,14 +25,29 @@ class FedDropoutAvgWorker(AggregationWorker):
         sent_data = super()._get_sent_data()
         assert isinstance(sent_data, ParameterMessage)
         self._drop_round += 1
-        key = jax.random.PRNGKey(
-            self.config.seed * 1_000_003 + self.worker_id * 1009 + self._drop_round
-        )
         parameter = sent_data.parameter
+        aligned = getattr(self.trainer, "reserved_quant_rng", None)
+        if aligned is not None:
+            # the SPMD stream (parallel/spmd_sparse.py local_train): the
+            # reserved per-round rng, folded by leaf POSITION in insertion
+            # order — identical mask bits, tight cross-executor parity
+            items = [
+                (i, name, jax.random.fold_in(aligned, i))
+                for i, name in enumerate(parameter)
+            ]
+        else:
+            key = jax.random.PRNGKey(
+                self.config.seed * 1_000_003
+                + self.worker_id * 1009
+                + self._drop_round
+            )
+            items = []
+            for i, name in enumerate(sorted(parameter)):
+                key, sub = jax.random.split(key)
+                items.append((i, name, sub))
         total_num = 0
         send_num = 0
-        for name in sorted(parameter):
-            key, sub = jax.random.split(key)
+        for _i, name, sub in items:
             keep = jax.random.bernoulli(
                 sub, p=1.0 - self._dropout_rate, shape=parameter[name].shape
             )

@@ -90,10 +90,17 @@ class AggregationWorker(Client):
         per-step exchanges, OBD phase logic), so stream alignment alone
         cannot make them bit-comparable — see PARITY.md."""
         super()._before_round()
-        if self.config.distributed_algorithm in ("fed_avg", "fed_paq"):
-            # fed_paq = fed_avg + the stochastic codec; the aligned stream
-            # ALSO reserves the quant rng, which _aggregation hands to the
-            # endpoint so the wire distortion matches the SPMD program's
+        if self.config.distributed_algorithm in (
+            "fed_avg",
+            "fed_paq",
+            "fed_dropout_avg",
+        ):
+            # fed_paq = fed_avg + the stochastic codec and fed_dropout_avg
+            # = fed_avg + per-element dropout; the aligned stream ALSO
+            # reserves the quant/drop rng, which _aggregation hands to the
+            # endpoint (fed_paq) or the worker draws directly
+            # (fed_dropout_avg) so the wire transform matches the SPMD
+            # program's
             from ..engine.executor import aligned_round_stream
 
             self.trainer.set_round_stream(
