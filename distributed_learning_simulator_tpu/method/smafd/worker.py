@@ -42,9 +42,43 @@ class SingleModelAFDWorker(ErrorFeedbackWorker):
             sent[name] = jnp.asarray(dense.reshape(np.shape(value)))
         return sent, send_num
 
+    def _aligned_dropout(self, delta: Params, rng) -> Params:
+        """The SPMD session's whole-tensor dropout rule, replicated
+        host-side from the aligned stream's reserved rng
+        (``parallel/spmd_sparse.py`` ``sparsify``): permutation by
+        ``jax.random.permutation`` over INSERTION order, greedy ``<=``
+        budget keep — identical kept sets, tight cross-executor parity."""
+        import jax
+        import numpy as np
+
+        names = list(delta)
+        # float32 throughout: the boundary `<=` must make the SPMD scan's
+        # exact f32 decisions
+        sizes = np.asarray([float(delta[k].size) for k in names], np.float32)
+        threshold = np.float32(
+            (1.0 - float(self.config.algorithm_kwargs["dropout_rate"]))
+            * np.sum(sizes)
+        )
+        order = np.asarray(jax.random.permutation(rng, len(names)))
+        partial = np.float32(0.0)
+        kept: Params = {}
+        keep_mask = {}
+        for position in order:
+            if np.float32(partial + sizes[position]) <= threshold:
+                partial = np.float32(partial + sizes[position])
+                keep_mask[names[position]] = True
+        for name in names:  # kept entries in insertion order
+            if keep_mask.get(name):
+                kept[name] = delta[name]
+        return kept
+
     def _sparsify(self, delta: Params) -> Params:
+        aligned = getattr(self.trainer, "reserved_quant_rng", None)
         if self._topk_ratio is not None:
             sent, send_num = self._topk_sparsify(delta)
+        elif aligned is not None:
+            sent = self._aligned_dropout(delta, aligned)
+            send_num = sum(int(v.size) for v in sent.values())
         else:
             sent = self._dropout.drop_parameters(delta)
             send_num = sum(int(v.size) for v in sent.values())

@@ -94,6 +94,7 @@ class AggregationWorker(Client):
             "fed_avg",
             "fed_paq",
             "fed_dropout_avg",
+            "single_model_afd",
         ):
             # fed_paq = fed_avg + the stochastic codec and fed_dropout_avg
             # = fed_avg + per-element dropout; the aligned stream ALSO
