@@ -45,6 +45,19 @@ def aligned_round_stream(seed: int, round_number: int, worker_id: int):
     return jax.random.fold_in(round_rng, worker_id)
 
 
+def obd_aligned_round_stream(seed: int, aggregate_index: int, worker_id: int):
+    """The FedOBD SPMD session's per-(aggregate, client) rng
+    (``parallel/spmd_obd.py`` run loop: a THREE-way split chain —
+    ``rng, round_rng, bcast_rng`` per aggregate — with client streams
+    from ``split(round_rng, n_slots)``; split prefixes are
+    slot-count-independent, so ``worker_id + 1`` suffices here)."""
+    rng = jax.random.PRNGKey(seed)
+    round_rng = rng
+    for _ in range(aggregate_index):
+        rng, round_rng, _bcast = jax.random.split(rng, 3)
+    return jax.random.split(round_rng, worker_id + 1)[worker_id]
+
+
 class PerformanceMetric:
     def __init__(self) -> None:
         self.epoch_metrics: dict[int, dict[str, float]] = {}

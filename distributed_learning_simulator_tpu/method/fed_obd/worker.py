@@ -52,6 +52,26 @@ class FedOBDWorker(AggregationWorker):
         self.config.round = self._round_num + 1
         self._register_aggregation()
 
+    def _before_round(self) -> None:
+        """Train the SPMD OBD session's exact rng stream (the 3-way split
+        chain, one link per AGGREGATE — ``obd_aligned_round_stream``), so
+        both executors follow the same trajectory.  With the shared phase
+        driver, deterministic block selection, and the deterministic
+        NNADQ codec, this is the last stream gap; the worker's
+        ``_round_num`` counts aggregates on both phases when
+        ``second_phase_epoch == 1`` (the per-epoch chain of a longer
+        phase 2 is not reproducible from one ``set_round_stream`` call —
+        those runs stay loosely compared)."""
+        super()._before_round()
+        if int(self.config.algorithm_kwargs.get("second_phase_epoch", 0)) == 1:
+            from ...engine.executor import obd_aligned_round_stream
+
+            self.trainer.set_round_stream(
+                obd_aligned_round_stream(
+                    self.config.seed, self._round_num, self.worker_id
+                )
+            )
+
     # ---- message flow ----
     def _load_result_from_server(self, result: Message) -> None:
         if PHASE_TWO_KEY in result.other_data:

@@ -53,6 +53,13 @@ SCRIPT_ALGOS = {
     "fed_obd_train.sh": ("fed_obd",),
 }
 
+#: ...and which MODELS the script's configs train — concurrent CI runs of
+#: the same algorithm (fed_obd smoke tests use LeNet5/MoE/LongContext)
+#: must not leak either
+SCRIPT_MODELS = {
+    "fed_obd_train.sh": ("densenet40", "TransformerClassificationModel"),
+}
+
 
 def run_script(script: str) -> dict:
     before = _sessions()
@@ -68,6 +75,13 @@ def run_script(script: str) -> dict:
             os.path.join(SESSION_DIR, algo) + os.sep for algo in algos
         )
         new = [d for d in new if d.startswith(prefixes)]
+    models = SCRIPT_MODELS.get(script)
+    if models is not None:
+        new = [
+            d
+            for d in new
+            if any(os.sep + m + os.sep in d for m in models)
+        ]
     runs = [_final_stats(d) for d in new]
     entry = {
         "wall_seconds": round(wall, 1),
