@@ -611,6 +611,9 @@ class SpmdFedAvgSession:
         # donate the old global params: the round returns the new ones, so
         # XLA can reuse the buffer instead of holding both copies live
         jitted = jax.jit(round_program, donate_argnums=(0,))
+        # bench introspection handle (compiled memory analysis — the
+        # tunneled axon platform returns no runtime memory_stats)
+        self._jitted_round_fn = jitted
 
         def fn(global_params, weights, rngs):
             return jitted(
