@@ -52,12 +52,13 @@ class SingleModelAFDWorker(ErrorFeedbackWorker):
         import numpy as np
 
         names = list(delta)
-        # float32 throughout: the boundary `<=` must make the SPMD scan's
-        # exact f32 decisions
+        # float32 throughout, with the threshold computed by the IDENTICAL
+        # np expression as the SPMD sparsify (spmd_sparse.py) — boundary
+        # `<=` decisions must match bit-for-bit
         sizes = np.asarray([float(delta[k].size) for k in names], np.float32)
         threshold = np.float32(
             (1.0 - float(self.config.algorithm_kwargs["dropout_rate"]))
-            * np.sum(sizes)
+            * np.sum(sizes, dtype=np.float32)
         )
         order = np.asarray(jax.random.permutation(rng, len(names)))
         partial = np.float32(0.0)

@@ -29,8 +29,6 @@ from .spmd import SpmdFedAvgSession, scan_local_epochs, shard_map_compat
 
 
 class SpmdFedDropoutAvgSession(SpmdFedAvgSession):
-    _uses_val_policy = False  # own round program; no val policy
-
     def _upload_cost_factor(self) -> float:
         return 1.0 - float(self.config.algorithm_kwargs["dropout_rate"])
 
@@ -39,10 +37,10 @@ class SpmdFedDropoutAvgSession(SpmdFedAvgSession):
         epochs = self.config.epoch
         dropout_rate = float(self.config.algorithm_kwargs["dropout_rate"])
 
-        def local_train(global_params, data, weight, rng):
+        def local_train(global_params, data, weight, rng, val=None):
             rng, drop_rng = jax.random.split(rng)
             params, summed = scan_local_epochs(
-                engine, epochs, global_params, data, rng
+                engine, epochs, global_params, data, rng, val_data=val
             )
 
             num, den = {}, {}
@@ -65,11 +63,11 @@ class SpmdFedDropoutAvgSession(SpmdFedAvgSession):
             summed = dict(summed, send_num=send_num)
             return {"num": num, "den": den}, summed
 
-        def round_program(global_params, weights, rngs, data):
-            def shard_body(global_params, data, weights, rngs):
+        def round_program(global_params, weights, rngs, data, val):
+            def shard_body(global_params, data, val, weights, rngs):
                 contributions, metrics = jax.vmap(
-                    local_train, in_axes=(None, 0, 0, 0)
-                )(global_params, data, weights, rngs)
+                    local_train, in_axes=(None, 0, 0, 0, 0)
+                )(global_params, data, weights, rngs, val if val else None)
                 local_sum = jax.tree.map(
                     lambda c: jnp.sum(c, axis=0), contributions
                 )
@@ -94,14 +92,22 @@ class SpmdFedDropoutAvgSession(SpmdFedAvgSession):
             return shard_map_compat(
                 shard_body,
                 self.mesh,
-                in_specs=(P(), P("clients"), P("clients"), P("clients")),
+                in_specs=(
+                    P(),
+                    P("clients"),
+                    P("clients"),
+                    P("clients"),
+                    P("clients"),
+                ),
                 out_specs=(P(), P()),
-            )(global_params, data, weights, rngs)
+            )(global_params, data, val, weights, rngs)
 
         jitted = jax.jit(round_program, donate_argnums=(0,))
 
         def fn(global_params, weights, rngs):
-            return jitted(global_params, weights, rngs, self._data)
+            return jitted(
+                global_params, weights, rngs, self._data, self._val_data or {}
+            )
 
         return fn
 
@@ -118,8 +124,6 @@ class SpmdSMAFDSession(SpmdFedAvgSession):
     ``simulation_lib/worker/error_feedback_worker.py:9-19``).  The file is
     worker_number × model-size; a missing/mismatched file degrades to a
     zero restart with a loud warning rather than failing the resume."""
-
-    _uses_val_policy = False  # own round program; no val policy
 
     def _err_path(self, base_dir: str) -> str:
         import os
@@ -218,10 +222,18 @@ class SpmdSMAFDSession(SpmdFedAvgSession):
             # random whole-tensor dropout under the parameter budget
             # (RandomDropoutAlgorithm semantics)
             names = list(delta)
-            sizes = jnp.asarray(
-                [float(delta[k].size) for k in names], jnp.float32
+            sizes_np = np.asarray(
+                [float(delta[k].size) for k in names], np.float32
             )
-            threshold = (1.0 - dropout_rate) * jnp.sum(sizes)
+            sizes = jnp.asarray(sizes_np)
+            # threshold as a HOST f32 constant (np.sum), not a device
+            # reduction: the threaded worker's aligned replication
+            # (method/smafd/worker.py::_aligned_dropout) computes the
+            # identical expression, so boundary keep decisions cannot
+            # diverge by backend reduction order on big models
+            threshold = np.float32(
+                (1.0 - dropout_rate) * np.sum(sizes_np, dtype=np.float32)
+            )
             order = jax.random.permutation(rng, len(names))
 
             def body(partial, i):
@@ -240,10 +252,10 @@ class SpmdSMAFDSession(SpmdFedAvgSession):
             send_num = jnp.sum(keep * sizes)
             return sent, send_num
 
-        def local_train(global_params, err, data, weight, rng):
+        def local_train(global_params, err, data, weight, rng, val=None):
             rng, sparse_rng = jax.random.split(rng)
             params, summed = scan_local_epochs(
-                engine, epochs, global_params, data, rng
+                engine, epochs, global_params, data, rng, val_data=val
             )
 
             selected = (weight > 0).astype(jnp.float32)
@@ -267,11 +279,14 @@ class SpmdSMAFDSession(SpmdFedAvgSession):
             summed = dict(summed, send_num=send_num * selected)
             return contribution, new_err, summed
 
-        def round_program(global_params, err_state, weights, rngs, data):
-            def shard_body(global_params, err_state, data, weights, rngs):
+        def round_program(global_params, err_state, weights, rngs, data, val):
+            def shard_body(global_params, err_state, data, val, weights, rngs):
                 contributions, new_err, metrics = jax.vmap(
-                    local_train, in_axes=(None, 0, 0, 0, 0)
-                )(global_params, err_state, data, weights, rngs)
+                    local_train, in_axes=(None, 0, 0, 0, 0, 0)
+                )(
+                    global_params, err_state, data, weights, rngs,
+                    val if val else None,
+                )
                 local_sum = jax.tree.map(
                     lambda c: jnp.sum(c, axis=0), contributions
                 )
@@ -295,15 +310,23 @@ class SpmdSMAFDSession(SpmdFedAvgSession):
             return shard_map_compat(
                 shard_body,
                 self.mesh,
-                in_specs=(P(), P("clients"), P("clients"), P("clients"), P("clients")),
+                in_specs=(
+                    P(),
+                    P("clients"),
+                    P("clients"),
+                    P("clients"),
+                    P("clients"),
+                    P("clients"),
+                ),
                 out_specs=(P(), P("clients"), P()),
-            )(global_params, err_state, data, weights, rngs)
+            )(global_params, err_state, data, val, weights, rngs)
 
         jitted = jax.jit(round_program, donate_argnums=(0, 1))
 
         def fn(global_params, weights, rngs):
             new_global, self._err_state, metrics = jitted(
-                global_params, self._err_state, weights, rngs, self._data
+                global_params, self._err_state, weights, rngs, self._data,
+                self._val_data or {},
             )
             return new_global, metrics
 

@@ -175,7 +175,9 @@ def test_fed_dropout_avg_executors_match_tightly(tmp_session_dir):
     """fed_dropout_avg = fed_avg + per-element Bernoulli upload dropout;
     the threaded worker now draws its masks from the aligned stream's
     reserved rng with the SPMD fold-by-leaf-position rule, so the wire
-    transform (and therefore the trajectory) is identical."""
+    transform (and therefore the trajectory) is identical — including at
+    epoch=2, where the SPMD session runs the iid best-of-round upload
+    policy in-program like fed_avg's."""
 
     def run(executor: str) -> dict:
         config = DistributedTrainingConfig(
@@ -183,7 +185,7 @@ def test_fed_dropout_avg_executors_match_tightly(tmp_session_dir):
             executor=executor,
             dataset_sampling="iid",
             algorithm_kwargs={"dropout_rate": 0.3},
-            **dict(VISION, round=2, epoch=1),
+            **dict(VISION, round=2, epoch=2),
         )
         return train(config)
 
@@ -201,9 +203,10 @@ def test_smafd_executors_match_tightly(tmp_session_dir):
     """single_model_afd (random whole-tensor dropout mode): the threaded
     worker replicates the SPMD session's permutation-budget keep rule
     from the reserved rng, and the error-feedback residual dynamics are
-    deterministic given identical kept sets — tight across executors.
-    (The topk_ratio mode keeps its documented tie-drift bound,
-    test_smafd_topk_drift.)"""
+    deterministic given identical kept sets — tight across executors,
+    including at epoch=2 (the SPMD session runs the iid best-of-round
+    upload policy in-program).  (The topk_ratio mode keeps its
+    documented tie-drift bound, test_smafd_topk_drift.)"""
 
     def run(executor: str) -> dict:
         config = DistributedTrainingConfig(
@@ -211,7 +214,7 @@ def test_smafd_executors_match_tightly(tmp_session_dir):
             executor=executor,
             dataset_sampling="iid",
             algorithm_kwargs={"dropout_rate": 0.3},
-            **dict(VISION, round=2, epoch=1),
+            **dict(VISION, round=2, epoch=2),
         )
         return train(config)
 

@@ -154,10 +154,15 @@ def test_schedule_is_the_minimal_gpipe_bubble(n_micro):
             if eqn.primitive.name == "scan":
                 found.append(eqn.params["length"])
             for sub in eqn.params.values():
-                # params hold ClosedJaxpr (.jaxpr) or raw Jaxpr (.eqns)
-                inner = getattr(sub, "jaxpr", sub)
-                if hasattr(inner, "eqns"):
-                    found.extend(scan_lengths(inner))
+                # params hold ClosedJaxpr (.jaxpr), raw Jaxpr (.eqns), or
+                # containers of them (cond's 'branches' tuple)
+                items = (
+                    sub if isinstance(sub, (tuple, list)) else (sub,)
+                )
+                for item in items:
+                    inner = getattr(item, "jaxpr", item)
+                    if hasattr(inner, "eqns"):
+                        found.extend(scan_lengths(inner))
         return found
 
     lengths = scan_lengths(jaxpr.jaxpr)
