@@ -58,6 +58,18 @@ def obd_aligned_round_stream(seed: int, aggregate_index: int, worker_id: int):
     return jax.random.split(round_rng, worker_id + 1)[worker_id]
 
 
+def obd_aligned_bcast_rng(seed: int, aggregate_index: int):
+    """The FedOBD SPMD session's broadcast-codec rng for one aggregate —
+    the third element of the same 3-way chain (the threaded server's
+    quantized broadcast draws it so fed_obd_sq's QSGD distortion matches
+    in-program)."""
+    rng = jax.random.PRNGKey(seed)
+    bcast = rng
+    for _ in range(aggregate_index):
+        rng, _round, bcast = jax.random.split(rng, 3)
+    return bcast
+
+
 class PerformanceMetric:
     def __init__(self) -> None:
         self.epoch_metrics: dict[int, dict[str, float]] = {}

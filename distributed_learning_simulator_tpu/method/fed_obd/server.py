@@ -24,6 +24,7 @@ class FedOBDServer(AggregationServer):
         super().__init__(**kwargs)
         self._driver = ObdRoundDriver.from_config(self.config)
         self._last_phase_name = ""  # phase that produced the pending stat
+        self._bcast_count = 0  # aggregates broadcast so far (codec chain)
         assert isinstance(self._endpoint, QuantServerEndpoint)
         # global-model broadcasts ride the same codec as uploads
         self._endpoint.quant_broadcast = True
@@ -111,6 +112,33 @@ class FedOBDServer(AggregationServer):
             result.end_training = True
             self._driver.stop_now()
         return result
+
+    def _before_send_result(self, result) -> None:
+        super()._before_send_result(result)
+        from ...message import ParameterMessage
+
+        if (
+            isinstance(result, ParameterMessage)
+            and not getattr(result, "is_initial", False)
+            and hasattr(self._endpoint, "set_quant_key")
+            and int(
+                self.config.algorithm_kwargs.get("second_phase_epoch", 0)
+            )
+            == 1
+        ):
+            # fed_obd_sq: the quantized broadcast draws the SPMD chain's
+            # bcast rng for this aggregate, folded by global leaf position
+            # (parallel/spmd_obd.py round_program's bcast loop); NNADQ
+            # endpoints have no set_quant_key and skip this
+            from ...engine.executor import obd_aligned_bcast_rng
+
+            self._bcast_count += 1
+            self._endpoint.set_quant_key(
+                obd_aligned_bcast_rng(self.config.seed, self._bcast_count),
+                fold_indices={
+                    name: i for i, name in enumerate(result.parameter)
+                },
+            )
 
     def _init_annotations(self) -> dict:
         # a resume that fast-forwarded into phase 2 must tell the freshly

@@ -85,6 +85,12 @@ class FedOBDWorker(AggregationWorker):
         super()._load_result_from_server(result=result)
 
     def _get_sent_data(self) -> Message:
+        # global leaf positions for the codec's fold-by-position rule
+        # (the SPMD program folds quant_rng by each leaf's index in the
+        # FULL param dict, even when only kept blocks travel the wire)
+        self._quant_fold_indices = {
+            name: i for i, name in enumerate(self.trainer.params)
+        }
         data = super()._get_sent_data()
         if self._spec.block_dropout:
             assert isinstance(data, ParameterMessage)

@@ -130,17 +130,29 @@ def stochastic_quantization(quantization_level: int = 255, use_pallas: bool | No
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
 
-    def quant(tree: Any, seed: int = 0, key=None) -> dict:
+    def quant(tree: Any, seed: int = 0, key=None, fold_indices=None) -> dict:
         """``key`` (a jax PRNGKey) overrides the integer seed: per-leaf
         keys come from ``split(key, n_leaves)`` — EXACTLY the stream the
         SPMD in-program codec draws (``parallel/spmd.py`` local_train),
-        which is what cross-executor fed_paq codec parity needs.  The
-        pallas packer has its own integer-seed rng, so the key path pins
-        the XLA leaf encoder."""
+        which is what cross-executor fed_paq codec parity needs.  With
+        ``fold_indices`` (a name → position map over the FULL parameter
+        dict), per-leaf keys come from ``fold_in(key, position)`` instead
+        — the FedOBD in-program rule, where a kept-block subset must
+        still draw each leaf's key by its global position
+        (``parallel/spmd_obd.py`` local_train).  The pallas packer has
+        its own integer-seed rng, so the key paths pin the XLA leaf
+        encoder."""
         from . import pallas_kernels as pk
 
         leaves, treedef = jax.tree.flatten(tree)
-        if key is not None:
+        if key is not None and fold_indices is not None:
+            names = sorted(tree) if isinstance(tree, dict) else []
+            assert len(names) == len(leaves), "fold_indices needs a flat dict"
+            keys = [
+                jax.random.fold_in(key, fold_indices[name])
+                for name in names
+            ]
+        elif key is not None:
             keys = jax.random.split(key, max(1, len(leaves)))
         else:
             keys = jax.random.split(

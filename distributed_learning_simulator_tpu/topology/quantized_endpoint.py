@@ -134,25 +134,38 @@ class QuantServerEndpoint(_QuantCodecMixin, ServerEndpoint):
                 ServerEndpoint.send(self, worker_id, data)
 
 
-class StochasticQuantClientEndpoint(QuantClientEndpoint):
+class _AlignedKeyMixin:
+    """One-shot PRNGKey (+ optional global fold-index map) for the next
+    encode — a worker/server hands over its reserved stream key so the
+    wire distortion matches the SPMD in-program codec (cross-executor
+    parity: fed_paq's split-per-leaf rule, fed_obd_sq's
+    fold-by-global-position rule)."""
+
+    _pending_key = None
+    _pending_fold = None
+
+    def set_quant_key(self, key, fold_indices=None) -> None:
+        self._pending_key = key
+        self._pending_fold = fold_indices
+
+    def _take_key(self):
+        key, self._pending_key = self._pending_key, None
+        fold, self._pending_fold = self._pending_fold, None
+        return key, fold
+
+
+class StochasticQuantClientEndpoint(_AlignedKeyMixin, QuantClientEndpoint):
     """QSGD stochastic quantization, 255 levels (reference
     ``quantized_endpoint.py:74-78``)."""
 
     def __init__(self, topology, worker_id, quantization_level: int = 255, **kwargs):
         super().__init__(topology, worker_id, **kwargs)
         self._q, self._dq = stochastic_quantization(quantization_level)
-        self._pending_key = None
-
-    def set_quant_key(self, key) -> None:
-        """One-shot PRNGKey for the next encode — the worker hands over
-        its round's reserved quant rng so the wire distortion matches the
-        SPMD in-program codec (cross-executor fed_paq parity)."""
-        self._pending_key = key
 
     def _quant(self, tree):
-        key, self._pending_key = self._pending_key, None
+        key, fold = self._take_key()
         if key is not None:
-            return self._q(tree, key=key)
+            return self._q(tree, key=key, fold_indices=fold)
         self._quant_seed += 1
         return self._q(tree, seed=self._quant_seed * 2 + self.worker_id)
 
@@ -160,12 +173,15 @@ class StochasticQuantClientEndpoint(QuantClientEndpoint):
         return self._dq(blob)
 
 
-class StochasticQuantServerEndpoint(QuantServerEndpoint):
+class StochasticQuantServerEndpoint(_AlignedKeyMixin, QuantServerEndpoint):
     def __init__(self, topology, quantization_level: int = 255, **kwargs):
         super().__init__(topology, **kwargs)
         self._q, self._dq = stochastic_quantization(quantization_level)
 
     def _quant(self, tree):
+        key, fold = self._take_key()
+        if key is not None:
+            return self._q(tree, key=key, fold_indices=fold)
         self._quant_seed += 1
         return self._q(tree, seed=self._quant_seed * 2 + 1)
 

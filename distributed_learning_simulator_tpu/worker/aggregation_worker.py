@@ -123,9 +123,14 @@ class AggregationWorker(Client):
     def _aggregation(self, sent_data: Message, **kwargs: Any) -> None:
         quant_key = getattr(self.trainer, "reserved_quant_rng", None)
         if quant_key is not None and hasattr(self._endpoint, "set_quant_key"):
-            # codec parity with the SPMD in-program path (fed_paq): the
-            # endpoint's next encode draws the reserved per-round key
-            self._endpoint.set_quant_key(quant_key)
+            # codec parity with the SPMD in-program path (fed_paq /
+            # fed_obd_sq): the endpoint's next encode draws the reserved
+            # per-round key; a worker that quantizes a SUBSET of leaves
+            # also provides the global fold-index map
+            self._endpoint.set_quant_key(
+                quant_key,
+                fold_indices=getattr(self, "_quant_fold_indices", None),
+            )
         self.send_data_to_server(sent_data)
         self._offload_from_device()
         self._get_result_from_server()
