@@ -277,8 +277,10 @@ def _long_context_transformer(
 def _causal_lm_transformer(dataset_collection, **kwargs) -> ModelContext:
     """GPT-style next-token LM trunk: the long-context stack with causal
     attention (fused-kernel/ring causal paths) and a per-token vocab
-    head.  Targets are the inputs shifted left; ``masked_ce_loss``
-    handles [B, L, V] logits with [B, L] targets elementwise."""
+    head.  ``loss_type="causal_lm"`` derives targets from the INPUT
+    tokens shifted left — any text dataset doubles as an LM corpus
+    (dataset labels are ignored), so the federated methods train it
+    unchanged."""
     kwargs.update(causal=True, lm_head=True)
     ctx = _long_context_transformer(dataset_collection, **kwargs)
     return ModelContext(
@@ -287,4 +289,9 @@ def _causal_lm_transformer(dataset_collection, **kwargs) -> ModelContext:
         example_input=ctx.example_input,
         num_classes=ctx.num_classes,
         dataset_type="text",
+        loss_type="causal_lm",
+        pad_id=dataset_collection.metadata.get("pad_id", 0),
+        # sequence-sharded twins (sp_axis mode) reduce the LM loss
+        # globally; the unsharded model reduces locally (axis "")
+        loss_sync_axis=str(kwargs.get("sp_axis", "") or ""),
     )

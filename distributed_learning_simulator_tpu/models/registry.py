@@ -40,7 +40,17 @@ class ModelContext:
     example_input: Any  # one example batch input (numpy, leading dim 1)
     num_classes: int
     dataset_type: str = "vision"
+    #: "softmax_ce" (classification) or "causal_lm" (next-token CE: the
+    #: model returns [B, L, V] logits and targets derive from the INPUT
+    #: tokens shifted left — dataset labels are ignored, so any text
+    #: dataset doubles as an LM corpus)
     loss_type: str = "softmax_ce"
+    pad_id: int = 0  # causal_lm: positions whose TARGET is pad are masked
+    #: causal_lm under sequence sharding: the loss must be the GLOBAL
+    #: masked mean over the shards' unequal token counts — the weighted
+    #: sum crosses shards via psum_symmetric so the engine's uniform
+    #: pmean-of-grads stays exact (parallel/collectives.py derives why)
+    loss_sync_axis: str = ""
     compute_dtype: Any = jnp.float32
     aux_loss_weight: float = 0.01  # Switch-style router balance weight
     # post-init param transform (e.g. seed the embed table from ingested
@@ -94,7 +104,59 @@ class ModelContext:
             rngs=rngs,
             mutable=["intermediates"],
         )
-        loss, aux = masked_ce_loss(logits, batch["target"], batch["mask"])
+        if self.loss_type == "causal_lm":
+            tokens = batch["input"]
+            length = tokens.shape[1]
+            if self.loss_sync_axis:
+                # sequence-sharded: position t of shard i predicts token
+                # t+1 of the GLOBAL sequence — the boundary target is the
+                # ring neighbor's first token, and only the global last
+                # position has no target
+                axis = self.loss_sync_axis
+                sp = jax.lax.psum(1, axis)
+                shard = jax.lax.axis_index(axis)
+                boundary = jax.lax.ppermute(
+                    tokens[:, :1],
+                    axis,
+                    [(s, (s - 1) % sp) for s in range(sp)],
+                )
+                targets = jnp.concatenate([tokens[:, 1:], boundary], axis=1)
+                pos = shard * length + jnp.arange(length)[None, :]
+                not_last = pos < sp * length - 1
+            else:
+                # single sequence: last position wraps to a filler, masked
+                targets = jnp.concatenate(
+                    [tokens[:, 1:], tokens[:, :1]], axis=1
+                )
+                not_last = jnp.arange(length)[None, :] < length - 1
+            token_mask = (
+                batch["mask"].astype(jnp.float32)[:, None]
+                * not_last
+                * (targets != self.pad_id)
+            )
+            mask_used = token_mask
+            loss, aux = masked_ce_loss(logits, targets, token_mask)
+            if self.loss_sync_axis:
+                from ..parallel.collectives import psum_symmetric
+
+                axis = self.loss_sync_axis
+                local_weighted = loss * aux["count"]  # = (nll·mask).sum()
+                global_count = jax.lax.psum(aux["count"], axis)
+                loss = psum_symmetric(local_weighted, axis) / jnp.maximum(
+                    global_count, 1.0
+                )
+                aux = {
+                    # per-element values are cross-shard sums — consumers
+                    # only ever .sum() loss_sum, so the total stays right
+                    "loss_sum": jax.lax.psum(aux["loss_sum"], axis),
+                    "correct": jax.lax.psum(aux["correct"], axis),
+                    "count": global_count,
+                }
+        else:
+            mask_used = batch["mask"]
+            loss, aux = masked_ce_loss(
+                logits, batch["target"], batch["mask"]
+            )
         aux_terms = [
             jnp.sum(jnp.asarray(leaf).astype(jnp.float32))
             for path, leaf in jax.tree_util.tree_flatten_with_path(
@@ -109,9 +171,9 @@ class ModelContext:
             loss = loss + aux_total
             # keep per-sample sums on the same objective, so train-step and
             # eval losses (which summarize loss_sum) stay comparable
-            aux["loss_sum"] = aux["loss_sum"] + aux_total * batch["mask"].astype(
-                jnp.float32
-            )
+            aux["loss_sum"] = aux["loss_sum"] + aux_total * jnp.asarray(
+                mask_used
+            ).astype(jnp.float32)
         return loss, aux
 
 

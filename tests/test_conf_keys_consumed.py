@@ -67,6 +67,7 @@ CONSUMERS: dict[tuple[str, str], list[str]] = {
     ("model_kwargs", "max_len"): ["models/text.py"],
     ("model_kwargs", "word_vector_name"): ["models/text.py"],
     ("model_kwargs", "n_experts"): ["models/moe.py"],
+    ("model_kwargs", "dropout_rate"): ["models/long_context.py"],
     ("model_kwargs", "expert_parallel"): ["parallel/spmd_ep.py", "training.py"],
     ("model_kwargs", "pipeline_stages"): ["models/text.py", "training.py"],
     ("model_kwargs", "pipeline_microbatches"): ["models/text.py"],
