@@ -1,0 +1,66 @@
+"""The driver contract on bench.py: ONE JSON line on stdout with the
+required fields, resilient to any individual measurement failing (the
+driver records whatever line is printed — a crashed bench records
+nothing)."""
+
+import io
+import json
+import sys
+
+
+def test_bench_main_prints_one_json_line(monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "measure_spmd", lambda: (0.5, 0.04))
+    monkeypatch.setattr(bench, "measure_threaded_baseline", lambda: 0.001)
+    monkeypatch.setattr(bench, "measure_vit", lambda: (1.6, 0.44))
+    monkeypatch.setattr(
+        bench,
+        "measure_long_context",
+        lambda: {"dtype": "bf16", "seq2048": {"fused_ms": 27.0}},
+    )
+    monkeypatch.setattr(
+        bench, "measure_large_scale", lambda: {"value": 0.2}
+    )
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    bench.main()
+    lines = [ln for ln in out.getvalue().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    payload = json.loads(lines[0])
+    for field in (
+        "metric",
+        "value",
+        "unit",
+        "vs_baseline",
+        "mfu",
+        "dense_shape",
+        "long_context",
+        "large_scale",
+        "headline_explained",
+    ):
+        assert field in payload, field
+    assert payload["metric"] == "fedavg_cifar10_100clients_rounds_per_sec"
+
+
+def test_bench_main_survives_measurement_failures(monkeypatch):
+    """Every optional section degrades to an error marker, never a crash
+    — the headline line must still print."""
+    import bench
+
+    def boom(*_a, **_k):
+        raise RuntimeError("measurement exploded")
+
+    monkeypatch.setattr(bench, "measure_spmd", lambda: (0.5, 0.04))
+    monkeypatch.setattr(bench, "measure_threaded_baseline", boom)
+    monkeypatch.setattr(bench, "measure_vit", boom)
+    monkeypatch.setattr(bench, "measure_long_context", boom)
+    monkeypatch.setattr(bench, "measure_large_scale", boom)
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    bench.main()
+    payload = json.loads(out.getvalue().strip())
+    assert payload["value"] == 0.5
+    assert payload["vs_baseline"] == 0.0
+    assert "error" in payload["long_context"]
+    assert "error" in payload["large_scale"]
