@@ -119,3 +119,25 @@ def test_causal_lm_trains_and_matches_under_ring_sp():
             )
     # perplexity is finite and improving-ish (sanity, not convergence)
     assert np.isfinite(base["performance"][2]["test_loss"])
+
+
+def test_causal_lm_ulysses_matches_unsharded():
+    """The causal path composes with BOTH sp implementations: Ulysses'
+    post-all-to-all full-sequence attention supports causal directly, and
+    the sharded LM loss is implementation-agnostic (ring boundary token +
+    global masked mean)."""
+
+    def lm_config(**model_extra):
+        config = _config(**model_extra)
+        config.model_name = "CausalLMTransformer"
+        config.model_kwargs = dict(config.model_kwargs, dropout_rate=0.0)
+        return config
+
+    base = train(lm_config())
+    uly = train(lm_config(sequence_parallel=4, sp_impl="ulysses"))
+    for key in ("test_loss", "test_accuracy"):
+        np.testing.assert_allclose(
+            uly["performance"][1][key],
+            base["performance"][1][key],
+            atol=2e-4,
+        )
