@@ -141,3 +141,26 @@ def test_causal_lm_ulysses_matches_unsharded():
             base["performance"][1][key],
             atol=2e-4,
         )
+
+
+def test_sequence_parallel_cross_executor_parity():
+    """Sequence parallelism is EXECUTOR-invariant too: the threaded path
+    (model-owned sp_mesh inside per-client jitted steps) and the SPMD SP
+    session (session-owned shard_map, clients scanned) train identical
+    fed_avg trajectories under the aligned rng streams."""
+    spmd_config = _config(sequence_parallel=4)
+    spmd_config.executor = "spmd"
+    spmd_config.round = 2
+    threaded_config = _config(sequence_parallel=4)
+    threaded_config.executor = "sequential"
+    threaded_config.round = 2
+    spmd = train(spmd_config)
+    threaded = train(threaded_config)
+    for round_number in (1, 2):
+        for key in ("test_loss", "test_accuracy"):
+            np.testing.assert_allclose(
+                spmd["performance"][round_number][key],
+                threaded["performance"][round_number][key],
+                rtol=0,
+                atol=1e-5,
+            )
