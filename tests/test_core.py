@@ -220,3 +220,40 @@ def test_remat_matches_plain_gradients():
         np.testing.assert_allclose(
             np.asarray(plain[key]), np.asarray(remat[key]), atol=1e-6
         )
+
+
+def test_aligned_stream_helpers_replicate_the_spmd_chains():
+    """The threaded executors replay the SPMD rng chains through these
+    pure helpers — pin the chain algebra itself (fed_avg: 2-way split +
+    fold_in by worker id; fed_obd: 3-way split per AGGREGATE with
+    slot-count-independent split prefixes)."""
+    import jax
+    import numpy as np
+
+    from distributed_learning_simulator_tpu.engine.executor import (
+        aligned_round_stream,
+        obd_aligned_bcast_rng,
+        obd_aligned_round_stream,
+    )
+
+    seed = 11
+    # fed_avg chain: round 3's client-7 stream
+    rng = jax.random.PRNGKey(seed)
+    for _ in range(3):
+        rng, round_rng = jax.random.split(rng)
+    expected = jax.random.fold_in(round_rng, 7)
+    np.testing.assert_array_equal(
+        np.asarray(aligned_round_stream(seed, 3, 7)), np.asarray(expected)
+    )
+
+    # OBD chain: aggregate 2's client-1 stream and bcast rng
+    rng = jax.random.PRNGKey(seed)
+    for _ in range(2):
+        rng, round_rng, bcast = jax.random.split(rng, 3)
+    np.testing.assert_array_equal(
+        np.asarray(obd_aligned_round_stream(seed, 2, 1)),
+        np.asarray(jax.random.split(round_rng, 8)[1]),  # n-independent
+    )
+    np.testing.assert_array_equal(
+        np.asarray(obd_aligned_bcast_rng(seed, 2)), np.asarray(bcast)
+    )
