@@ -235,6 +235,96 @@ def measure_large_scale() -> dict:
     return entry
 
 
+# server-side aggregation microbench: the ParamVec flat path vs the
+# per-tensor walk, streaming LS_SELECTED uploads of a transformer-shaped
+# param dict through FedAVGAlgorithm — the server hot path in isolation
+# (the whole-round numbers above fold it into one program, hiding it)
+AGG_UPLOADS = LS_SELECTED
+AGG_REPEATS = 3
+
+
+def _agg_params(rng):
+    """A bert_small-shaped flat param dict (~110 tensors, ~4M params) —
+    enough tensors that dispatch overhead, not FLOPs, dominates."""
+    import numpy as np
+
+    params = {}
+    for layer in range(4):
+        base = f"encoder/layer_{layer}"
+        for name, shape in (
+            ("attn/qkv/kernel", (256, 768)),
+            ("attn/qkv/bias", (768,)),
+            ("attn/out/kernel", (256, 256)),
+            ("attn/out/bias", (256,)),
+            ("mlp/dense1/kernel", (256, 1024)),
+            ("mlp/dense1/bias", (1024,)),
+            ("mlp/dense2/kernel", (1024, 256)),
+            ("mlp/dense2/bias", (256,)),
+            ("ln1/scale", (256,)),
+            ("ln1/bias", (256,)),
+            ("ln2/scale", (256,)),
+            ("ln2/bias", (256,)),
+        ):
+            params[f"{base}/{name}"] = rng.normal(size=shape).astype(np.float32)
+    params["embed/kernel"] = rng.normal(size=(8192, 256)).astype(np.float32)
+    params["head/kernel"] = rng.normal(size=(256, 4)).astype(np.float32)
+    params["head/bias"] = rng.normal(size=(4,)).astype(np.float32)
+    return params
+
+
+def _time_agg_round(flat: bool, uploads) -> float:
+    """Seconds for one full streaming aggregation round (process every
+    upload + finalize), best of AGG_REPEATS."""
+    import types
+
+    import jax
+    import numpy as np
+
+    from distributed_learning_simulator_tpu.algorithm.fed_avg_algorithm import (
+        FedAVGAlgorithm,
+    )
+    from distributed_learning_simulator_tpu.message import ParameterMessage
+
+    config = types.SimpleNamespace(algorithm_kwargs={"flat_aggregation": flat})
+    best = float("inf")
+    for _ in range(1 + AGG_REPEATS):  # first pass is compile warmup
+        algorithm = FedAVGAlgorithm()
+        algorithm.set_config(config)
+        start = time.monotonic()
+        for worker_id, params in enumerate(uploads):
+            algorithm.process_worker_data(
+                worker_id,
+                ParameterMessage(parameter=dict(params), dataset_size=32 + worker_id),
+            )
+        result = algorithm.aggregate_worker_data()
+        jax.block_until_ready(jax.tree.leaves(result.parameter))
+        best = min(best, time.monotonic() - start)
+        algorithm.clear_worker_data()
+    return best
+
+
+def measure_aggregation() -> dict:
+    """Flat-vs-per-tensor server aggregation wall time per round
+    (``agg_path`` records which path production servers take by default)."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    template = _agg_params(rng)
+    uploads = [
+        {k: v + np.float32(0.01 * i) for k, v in template.items()}
+        for i in range(AGG_UPLOADS)
+    ]
+    flat_s = _time_agg_round(flat=True, uploads=uploads)
+    per_tensor_s = _time_agg_round(flat=False, uploads=uploads)
+    return {
+        "agg_path": "flat",
+        "uploads_per_round": AGG_UPLOADS,
+        "flat_s_per_round": round(flat_s, 4),
+        "per_tensor_s_per_round": round(per_tensor_s, 4),
+        "speedup": round(per_tensor_s / flat_s, 2) if flat_s else 0.0,
+    }
+
+
 def measure_threaded_baseline() -> float:
     """Simulation-faithful executor throughput, scaled to WORKERS clients.
 
@@ -452,6 +542,12 @@ def main() -> None:
         large_scale = measure_large_scale()
     except Exception as exc:
         large_scale = {"error": str(exc)[:200]}
+    # server aggregation wall time per round, flat (ParamVec) vs per-tensor
+    # — the threaded server hot path the whole-round programs fold away
+    try:
+        aggregation = measure_aggregation()
+    except Exception as exc:
+        aggregation = {"agg_path": "flat", "error": str(exc)[:200]}
     # canonical north-star workloads (VERDICT r4 item 7): full
     # gtg_shapley_train.sh / fed_obd_train.sh runs are ~1 h on-chip, so
     # they are measured once per machine by tools/run_canonical.py and
@@ -491,6 +587,11 @@ def main() -> None:
                 },
                 "long_context": lc,
                 "large_scale": large_scale,
+                # which server aggregation path production configs take
+                # ("flat" ParamVec pipeline vs the legacy "per_tensor"
+                # walk) + its isolated wall time per round
+                "agg_path": aggregation.get("agg_path", "flat"),
+                "aggregation": aggregation,
                 "canonical": canonical,
             }
         )

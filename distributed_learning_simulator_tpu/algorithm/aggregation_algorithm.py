@@ -10,7 +10,6 @@ jax arrays instead of CPU float64 tensor walks.
 
 from typing import Any
 
-import jax
 import jax.numpy as jnp
 
 from ..message import DeltaParameterMessage, Message, ParameterMessage
@@ -51,37 +50,29 @@ class AggregationAlgorithm:
         weights: dict[int, float],
         key: str = "parameter",
     ) -> Params:
-        """Fixed-worker-order float32 weighted sum, jit-fused per call.
+        """Fixed-worker-order float32 weighted sum on the ParamVec batch
+        path: the K selected uploads stack into ONE ``[K, D]`` matrix and
+        aggregate with one jitted matvec (full-precision on TPU, Pallas
+        fused accumulate for tile-sized models), then one split restores
+        the param dict — one dispatch instead of O(workers × tensors).
+        Beyond the ``FLAT_BATCH_MAX_ELEMENTS`` memory ceiling the stack
+        degrades to K streaming donated adds (no ``[K, D]`` temporary).
 
         The reference accumulates in CPU float64
         (``fed_avg_algorithm.py:44``); float64 is emulated/slow on TPU, so we
         use a fixed summation order (sorted worker ids) in float32 — see
         SURVEY.md §7 hard-part 3.
         """
+        from ..ops import pytree
+
         worker_ids = sorted(all_worker_data)
         assert worker_ids
         first = getattr(all_worker_data[worker_ids[0]], key)
-        use_pallas = jax.default_backend() == "tpu" and len(worker_ids) > 1
-        result: Params = {}
-        for name in first:
-            values = [getattr(all_worker_data[w], key)[name] for w in worker_ids]
-            if use_pallas and values[0].size >= 8 * 128:
-                # fused multiply-accumulate kernel: no [C, N] weighted
-                # temporary (ops/pallas_kernels.py)
-                from ..ops.pallas_kernels import weighted_accum
-
-                stacked = jnp.stack(
-                    [jnp.asarray(v).reshape(-1) for v in values]
-                )
-                w_arr = jnp.asarray([weights[w] for w in worker_ids], jnp.float32)
-                acc = weighted_accum(stacked, w_arr).reshape(values[0].shape)
-            else:
-                acc = None
-                for value, worker_id in zip(values, worker_ids):
-                    term = value.astype(jnp.float32) * weights[worker_id]
-                    acc = term if acc is None else acc + term
-            result[name] = acc.astype(first[name].dtype)
-        return result
+        layout = pytree.ParamVecLayout.of(first)
+        uploads = [getattr(all_worker_data[w], key) for w in worker_ids]
+        assert all(layout.matches(u) for u in uploads), "inconsistent upload keys"
+        w_list = [float(weights[w]) for w in worker_ids]
+        return pytree.flat_weighted_avg_params(uploads, w_list, layout)
 
     def process_worker_data(
         self,

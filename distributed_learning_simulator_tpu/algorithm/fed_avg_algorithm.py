@@ -4,12 +4,20 @@ TPU-native equivalent of ``simulation_lib/algorithm/fed_avg_algorithm.py:11-110`
 dataset-size-weighted average with a **streaming** accumulation mode that
 frees each worker's tensors as they arrive to bound memory, per-name weight
 accumulators (subclasses may return per-element weight arrays — see
-``fed_dropout_avg``), and a batch fallback path.  Accumulation is a jitted
-device add in float32 with fixed arrival order instead of the reference's CPU
-float64 walk (SURVEY.md §7 hard-part 3); setting
-``algorithm_kwargs.float64_parity: true`` switches to the native host
-float64 accumulator (``native/fastops.cc``) for bit-level reference-parity
-runs.
+``fed_dropout_avg``), and a batch fallback path.
+
+The streaming hot path runs on the **ParamVec** representation
+(``ops/pytree.py``): each upload is flattened and accumulated into one
+contiguous float32 vector by a single donated jitted ``acc += w · vec`` —
+one dispatch per upload, in-place buffer reuse — and finalize is one divide
+plus one split back through the static layout.  Subclasses that override
+the per-name weighting hooks (fed_dropout_avg's per-element weights) fall
+back to the per-tensor walk; ``algorithm_kwargs.flat_aggregation: false``
+forces the fallback.  Both accumulate in float32 with fixed arrival order
+instead of the reference's CPU float64 walk (SURVEY.md §7 hard-part 3);
+setting ``algorithm_kwargs.float64_parity: true`` switches to the native
+host float64 accumulator (``native/fastops.cc``) for bit-level
+reference-parity runs.
 """
 
 import functools
@@ -19,7 +27,8 @@ import jax
 import jax.numpy as jnp
 
 from ..message import Message, ParameterMessage
-from ..ops.pytree import Params
+from ..ops import pytree
+from ..ops.pytree import ParamVecLayout, Params
 from ..utils.logging import get_logger
 from .aggregation_algorithm import AggregationAlgorithm, check_finite
 
@@ -36,6 +45,10 @@ class FedAVGAlgorithm(AggregationAlgorithm):
         self._dtypes: dict[str, Any] = {}
         self._total_weights: dict[str, Any] = {}
         self._parameter: Params = {}
+        # ParamVec streaming state (the flat hot path)
+        self._vec_acc: jax.Array | None = None
+        self._vec_layout: ParamVecLayout | None = None
+        self._vec_total_weight: float = 0.0
         self._end_training = False
         self._other_data: dict = {}
 
@@ -59,6 +72,29 @@ class FedAVGAlgorithm(AggregationAlgorithm):
         if server is None:
             return False
         return bool(server.config.algorithm_kwargs.get("float64_parity"))
+
+    @property
+    def _flat_path(self) -> bool:
+        """Whether streaming accumulation rides the ParamVec hot path.
+
+        The flat vector carries ONE scalar weight per upload and one divide
+        at finalize, so any subclass that re-derives per-name (or
+        per-element) weights keeps the per-tensor walk; so does the f64
+        reference-parity mode and ``algorithm_kwargs.flat_aggregation:
+        false`` (the A/B escape hatch the bench contract records)."""
+        if type(self)._get_weight is not FedAVGAlgorithm._get_weight:
+            return False
+        if type(self)._apply_total_weight is not FedAVGAlgorithm._apply_total_weight:
+            return False
+        if self._float64_parity:
+            return False
+        server = getattr(self, "_server", None)
+        config = getattr(server, "config", None) or self._config
+        if config is not None and not config.algorithm_kwargs.get(
+            "flat_aggregation", True
+        ):
+            return False
+        return True
 
     def _process_worker_data_f64(self, data: ParameterMessage) -> None:
         """Reference-parity path: host float64 streaming accumulation
@@ -91,6 +127,32 @@ class FedAVGAlgorithm(AggregationAlgorithm):
             self._process_worker_data_f64(data)
             self._end_training |= data.end_training
             self._merge_other_data(data.other_data)
+            data.parameter = {}
+            return
+        if self._flat_path:
+            # ParamVec streaming: ONE fused dispatch per upload (donated
+            # in-place accumulate), vs the per-tensor O(tensors) walk below
+            weight = float(
+                self._get_weight(
+                    dataset_size=data.dataset_size, name="", parameter=None
+                )
+            )
+            if self._vec_acc is None:
+                self._vec_layout = ParamVecLayout.of(data.parameter)
+                self._vec_acc = pytree.flat_weighted_vec(data.parameter, weight)
+            else:
+                assert self._vec_layout is not None
+                assert self._vec_layout.matches(
+                    data.parameter
+                ), "inconsistent upload keys"
+                self._vec_acc = pytree.flat_acc_add(
+                    self._vec_acc, data.parameter, weight
+                )
+            self._vec_total_weight += weight
+            self._end_training |= data.end_training
+            self._merge_other_data(data.other_data)
+            # release worker tensors immediately (reference bounds memory
+            # the same way, fed_avg_algorithm.py:53-54)
             data.parameter = {}
             return
         terms = {}
@@ -143,6 +205,20 @@ class FedAVGAlgorithm(AggregationAlgorithm):
                 end_training=self._end_training,
                 other_data=dict(self._other_data),
             )
+        if self._vec_acc is not None:
+            # ParamVec finalize: one divide, one finite check (a single
+            # reduction), one split back through the static layout
+            assert self._vec_layout is not None
+            vec = pytree.flat_scale(self._vec_acc, self._vec_total_weight)
+            self._vec_acc = None
+            self._vec_total_weight = 0.0
+            pytree.check_finite_vec(vec, self._vec_layout)
+            parameter = pytree.split_flat_params(vec, self._vec_layout)
+            return ParameterMessage(
+                parameter=parameter,
+                end_training=self._end_training,
+                other_data=dict(self._other_data),
+            )
         assert self._parameter, "no worker parameters to aggregate"
         parameter = self._parameter
         self._parameter = {}
@@ -189,5 +265,8 @@ class FedAVGAlgorithm(AggregationAlgorithm):
         self._parameter = {}
         self._total_weights = {}
         self._dtypes = {}
+        self._vec_acc = None
+        self._vec_layout = None  # rebuilt on first upload (key sets may change)
+        self._vec_total_weight = 0.0
         self._end_training = False
         self._other_data = {}

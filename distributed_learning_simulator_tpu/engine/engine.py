@@ -52,6 +52,16 @@ class ComputeEngine:
         # trade recompute for activation memory — the standard TPU lever
         # when HBM, not FLOPs, binds (extra_hyper_parameters: {remat: true})
         self.use_remat = bool(hyper_parameter.extra.get("remat", False))
+        # opt-in buffer donation for the jitted entry points
+        # (extra_hyper_parameters: {donate_buffers: true}): XLA reuses the
+        # incoming params/opt_state buffers for the outputs, halving the
+        # entry points' HBM footprint.  OFF by default because the threaded
+        # executor's param buffers are shared with host-side caches
+        # (ModelCache, best-model hooks) across rounds — only callers that
+        # drop the old buffers every call (SPMD-style step-and-replace
+        # loops) may turn it on.  Flip before first use of the cached
+        # entry points.
+        self.donate_buffers = bool(hyper_parameter.extra.get("donate_buffers", False))
 
     # ---- pure functions (also used by the SPMD executor under vmap/shard_map)
 
@@ -175,9 +185,10 @@ class ComputeEngine:
 
     @functools.cached_property
     def train_epoch(self):
-        # no donation: params/opt_state buffers are shared with host-side
-        # caches (ModelCache, best-model hooks) across rounds
-        return jax.jit(self.train_epoch_fn)
+        # donation only on request (see donate_buffers above): default
+        # callers share the params/opt_state buffers with host-side caches
+        donate = (0, 1) if self.donate_buffers else ()
+        return jax.jit(self.train_epoch_fn, donate_argnums=donate)
 
     @functools.cached_property
     def train_step(self):
@@ -185,7 +196,8 @@ class ComputeEngine:
             params, opt_state, metrics, _ = self.train_step_fn(params, opt_state, batch, rng)
             return params, opt_state, metrics
 
-        return jax.jit(step)
+        donate = (0, 1) if self.donate_buffers else ()
+        return jax.jit(step, donate_argnums=donate)
 
     @functools.cached_property
     def evaluate(self):

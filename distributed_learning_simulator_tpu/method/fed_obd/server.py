@@ -51,6 +51,11 @@ class FedOBDServer(AggregationServer):
         kept_keys, phase1_kept = replay_resume(self._driver, stats)
         for stale in [k for k in stats if k > 0 and k not in kept_keys]:
             del stats[stale]
+        # each kept aggregate was broadcast once (non-initial, so it drew a
+        # codec rng): continue the aligned bcast chain from there — the SPMD
+        # session advances its 3-way rng chain the same way on resume
+        # (spmd_obd.py run: one chain step per replayed aggregate)
+        self._bcast_count = len(kept_keys)
         # the base resume numbered the round after the LATEST checkpoint;
         # the replayed schedule may have dropped that tail — round and
         # params must follow the kept prefix (stat key == checkpoint key)

@@ -6,14 +6,22 @@ reference's workers, e.g. ``simulation_lib/worker/aggregation_worker.py:4``).
 
 import enum
 
+try:  # python >= 3.11
+    _StrEnum = enum.StrEnum
+except AttributeError:  # python 3.10: str+Enum mixin has the same semantics
 
-class MachineLearningPhase(enum.StrEnum):
+    class _StrEnum(str, enum.Enum):
+        def __str__(self) -> str:  # StrEnum prints the value, not the name
+            return str(self.value)
+
+
+class MachineLearningPhase(_StrEnum):
     Training = "training"
     Validation = "validation"
     Test = "test"
 
 
-class ExecutorHookPoint(enum.StrEnum):
+class ExecutorHookPoint(_StrEnum):
     """Hook points fired by the trainer engine (reference hook points used:
     AFTER_BATCH, AFTER_EPOCH, AFTER_EXECUTE, OPTIMIZER_STEP — SURVEY.md §2.13)."""
 

@@ -9,6 +9,8 @@ the reference's module-path-keyed ``TensorDict``), which makes block
 partitioning (FedOBD), per-tensor dropout, and parameter diffs natural.
 """
 
+import dataclasses
+import functools
 from collections.abc import Mapping
 from typing import Any
 
@@ -65,6 +67,175 @@ def params_from_vector_like(vector: jax.Array, like: Params) -> Params:
     return out
 
 
+# --------------------------------------------------------------- ParamVec
+# The server aggregation hot path's parameter representation: ONE contiguous
+# float32 vector plus a static layout derived once per model.  The per-tensor
+# walk (one astype+mul+add per tensor per worker — O(workers × tensors) tiny
+# XLA dispatches per round) collapses to one fused program per upload plus
+# one divide + one split per round.  The layout contract (also the wire
+# contract for flat-encoded codec payloads, ops/quantization.py):
+#
+# * keys sorted lexicographically ("/"-joined module paths, same order as
+#   ``cat_params_to_vector``);
+# * each tensor raveled row-major (C order) and cast to float32;
+# * ``offsets[i]`` is the start of ``keys[i]`` in the vector; scalars take
+#   one slot; ``size`` is the total length.
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamVecLayout:
+    """Static (hashable) layout of a flat parameter vector."""
+
+    keys: tuple[str, ...]
+    shapes: tuple[tuple[int, ...], ...]
+    dtypes: tuple[str, ...]
+    offsets: tuple[int, ...]
+    size: int
+
+    @classmethod
+    def of(cls, params: Mapping[str, Any]) -> "ParamVecLayout":
+        keys = tuple(sorted(params))
+        shapes: list[tuple[int, ...]] = []
+        dtypes: list[str] = []
+        offsets: list[int] = []
+        offset = 0
+        for key in keys:
+            value = params[key]
+            shape = tuple(int(s) for s in value.shape)
+            shapes.append(shape)
+            dtypes.append(str(value.dtype))
+            offsets.append(offset)
+            offset += int(np.prod(shape)) if shape else 1
+        return cls(keys, tuple(shapes), tuple(dtypes), tuple(offsets), offset)
+
+    def matches(self, params: Mapping[str, Any]) -> bool:
+        """Keys AND shapes must agree — a same-size shape mismatch (e.g. a
+        transposed kernel) would otherwise flatten into a silently
+        misaligned sum where the per-tensor walk raised."""
+        if tuple(sorted(params)) != self.keys:
+            return False
+        return all(
+            tuple(int(s) for s in params[key].shape) == shape
+            for key, shape in zip(self.keys, self.shapes)
+        )
+
+    def key_at(self, index: int) -> str:
+        """The parameter name owning vector position ``index``."""
+        pos = int(np.searchsorted(np.asarray(self.offsets), index, "right")) - 1
+        return self.keys[max(pos, 0)]
+
+    def split(self, vector: jax.Array, cast: bool = True) -> Params:
+        """Traceable inverse of :func:`flatten_params`: static slices (no
+        dynamic_slice walk, unlike ``params_from_vector_like``), reshaped to
+        the recorded shapes and (with ``cast``) the recorded dtypes."""
+        out: Params = {}
+        for key, shape, dtype, offset in zip(
+            self.keys, self.shapes, self.dtypes, self.offsets
+        ):
+            size = int(np.prod(shape)) if shape else 1
+            leaf = jax.lax.slice_in_dim(vector, offset, offset + size).reshape(shape)
+            out[key] = leaf.astype(dtype) if cast else leaf
+        return out
+
+
+def _flatten_f32(params: Mapping[str, jax.Array]) -> jax.Array:
+    """Trace-level ParamVec flatten: sorted keys, row-major ravel, float32."""
+    return jnp.concatenate(
+        [jnp.ravel(params[k]).astype(jnp.float32) for k in sorted(params)]
+    )
+
+
+@jax.jit
+def flatten_params(params: Params) -> jax.Array:
+    """ParamVec flatten as ONE dispatch."""
+    return _flatten_f32(params)
+
+
+@jax.jit
+def flat_weighted_vec(params: Params, weight) -> jax.Array:
+    """``flatten(params) · w`` — the streaming accumulator's first term."""
+    return _flatten_f32(params) * jnp.float32(weight)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def flat_acc_add(acc: jax.Array, params: Params, weight) -> jax.Array:
+    """``acc += flatten(params) · w`` with the accumulator buffer donated —
+    THE streaming-FedAvg hot path: one fused dispatch per upload, XLA
+    updates the accumulator in place (no per-round alloc churn).  The
+    weight rides as a traced scalar, so distinct weights never retrace."""
+    return acc + _flatten_f32(params) * jnp.float32(weight)
+
+
+@jax.jit
+def flat_scale(vec: jax.Array, scale) -> jax.Array:
+    """One divide: the streaming finalize before the split."""
+    return vec / jnp.float32(scale)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def split_flat_params(vec: jax.Array, layout: ParamVecLayout, cast: bool = True) -> Params:
+    """One split back to the param dict via the static layout."""
+    return layout.split(vec, cast=cast)
+
+
+def _matvec_f32(mat: jax.Array, weights: jax.Array) -> jax.Array:
+    """``w @ [K, D]`` in full float32 (TPU default matmul precision is
+    bf16-ish — aggregation numerics need the HIGHEST pass), via the fused
+    Pallas accumulator when the backend has it and the vector is tile-sized."""
+    if jax.default_backend() == "tpu" and mat.shape[0] > 1 and mat.shape[1] >= 8 * 128:
+        from .pallas_kernels import weighted_accum
+
+        return weighted_accum(mat, weights.astype(jnp.float32))
+    return jnp.einsum(
+        "k,kd->d",
+        weights.astype(jnp.float32),
+        mat,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def flat_weighted_params(
+    param_dicts: tuple, weights: jax.Array, layout: ParamVecLayout
+) -> Params:
+    """Batch ParamVec aggregation as ONE dispatch: stack K uploads into a
+    ``[K, D]`` matrix, one matvec, one split back through the layout (leaf
+    dtypes restored)."""
+    mat = jnp.stack([_flatten_f32(p) for p in param_dicts])
+    return layout.split(_matvec_f32(mat, weights), cast=True)
+
+
+#: K × D ceiling for the stacked batch matvec: beyond it the [K, D] float32
+#: copy (a second whole-upload-set of HBM on top of the retained uploads)
+#: costs more than the single-dispatch win, so the batch path degrades to
+#: K streaming donated adds — same numerics, no stacked temporary
+FLAT_BATCH_MAX_ELEMENTS = 1 << 28
+
+
+def flat_weighted_avg_params(param_dicts, weights, layout: ParamVecLayout) -> Params:
+    """The batch aggregation entry point: one stacked matvec for normal
+    sizes, streaming donated accumulation when ``K × D`` would blow the
+    memory budget (``FLAT_BATCH_MAX_ELEMENTS``)."""
+    if len(param_dicts) * layout.size > FLAT_BATCH_MAX_ELEMENTS:
+        acc = flat_weighted_vec(param_dicts[0], weights[0])
+        for params, weight in zip(param_dicts[1:], weights[1:]):
+            acc = flat_acc_add(acc, params, weight)
+        return split_flat_params(acc, layout)
+    return flat_weighted_params(
+        tuple(param_dicts), jnp.asarray(weights, jnp.float32), layout
+    )
+
+
+def check_finite_vec(vec: jax.Array, layout: ParamVecLayout | None = None) -> None:
+    """NaN guard on a ParamVec: ONE reduction on the happy path; only a
+    failure pays the per-element walk to name the offending parameter."""
+    if bool(jnp.all(jnp.isfinite(vec))):
+        return
+    bad = int(np.argmax(~np.asarray(jnp.isfinite(vec))))
+    name = layout.key_at(bad) if layout is not None else f"vector[{bad}]"
+    raise FloatingPointError(f"non-finite aggregated parameter {name}")
+
+
 def params_diff(new: Params, old: Params) -> Params:
     return {k: new[k] - old[k] for k in new}
 
@@ -86,12 +257,16 @@ def params_l2(params: Params) -> jax.Array:
 
 
 def weighted_sum(param_list: list[Params], weights) -> Params:
-    """``sum_i params_i * w_i`` over a python list of param dicts."""
-    keys = param_list[0].keys()
-    return {
-        k: sum(p[k].astype(jnp.float32) * w for p, w in zip(param_list, weights))
-        for k in keys
-    }
+    """``sum_i params_i * w_i`` over a python list of param dicts — one
+    stacked ``[K, D]`` ParamVec matvec instead of a per-tensor mul/add walk.
+    Leaves come back float32 (the historical contract of this helper)."""
+    layout = ParamVecLayout.of(param_list[0])
+    assert all(
+        layout.matches(p) for p in param_list
+    ), "inconsistent param keys/shapes"
+    mat = jnp.stack([flatten_params(p) for p in param_list])
+    vec = _matvec_f32(mat, jnp.asarray(list(weights), jnp.float32))
+    return layout.split(vec, cast=False)
 
 
 def tree_cast(tree, dtype):

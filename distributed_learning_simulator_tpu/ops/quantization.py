@@ -25,7 +25,45 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .pytree import param_nbytes
+from .pytree import ParamVecLayout, flatten_params, param_nbytes, split_flat_params
+
+#: reserved leaf name carrying a whole model as one ParamVec in a flat blob
+_FLAT_KEY = "__param_vec__"
+
+
+def _flat_encode_tree(params: dict) -> tuple[dict, ParamVecLayout]:
+    """ParamVec entry point shared by the codecs: collapse a flat param
+    dict to a single-leaf tree (ONE encode dispatch instead of one per
+    tensor); the layout rides in the blob so decode can split back."""
+    layout = ParamVecLayout.of(params)
+    return {_FLAT_KEY: flatten_params(params)}, layout
+
+
+def _flat_decode_tree(tree: dict, layout: ParamVecLayout) -> dict:
+    return split_flat_params(tree[_FLAT_KEY], layout)
+
+
+def _flat_encodable(tree: Any) -> bool:
+    return (
+        isinstance(tree, dict)
+        and len(tree) > 1
+        and _FLAT_KEY not in tree
+        and all(hasattr(v, "shape") and hasattr(v, "dtype") for v in tree.values())
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _segment_ids(layout: ParamVecLayout) -> jnp.ndarray:
+    """Per-element tensor index ``[D]`` for a layout, DEVICE-resident and
+    cached (flat QSGD keeps per-tensor scales via one segment reduction;
+    re-uploading ~4·D bytes per message would tax the very hot path the
+    flat payload exists to thin out)."""
+    sizes = [
+        int(np.prod(shape)) if shape else 1 for shape in layout.shapes
+    ]
+    return jnp.asarray(
+        np.repeat(np.arange(len(sizes), dtype=np.int32), sizes)
+    )
 
 
 # ---------------------------------------------------------------- bit packing
@@ -52,16 +90,23 @@ def _unpack_uint(packed: jnp.ndarray, bits: int, n: int) -> jnp.ndarray:
 
 
 # ------------------------------------------------------- stochastic (QSGD)
-def _sq_levels(flat: jnp.ndarray, key: jax.Array, level: int):
-    """The QSGD numerics shared by every executor path: abs-max scale +
-    stochastic rounding to ``level`` magnitude levels."""
-    scale = jnp.maximum(jnp.max(jnp.abs(flat)), 1e-12)
+def _sq_round(flat: jnp.ndarray, scale, key: jax.Array, level: int):
+    """THE QSGD stochastic-rounding step: ``|x| / scale`` snapped to
+    ``level`` magnitude levels, round direction drawn from ``key``.
+    ``scale`` may be a scalar (per-tensor path) or a per-element vector
+    (flat ParamVec path) — one definition, one distortion profile."""
     normalized = jnp.abs(flat) / scale * level
     floor = jnp.floor(normalized)
     prob = normalized - floor
     rnd = jax.random.uniform(key, flat.shape)
-    q = floor + (rnd < prob).astype(jnp.float32)  # stochastic rounding
-    return q, scale
+    return floor + (rnd < prob).astype(jnp.float32)
+
+
+def _sq_levels(flat: jnp.ndarray, key: jax.Array, level: int):
+    """The QSGD numerics shared by every executor path: abs-max scale +
+    stochastic rounding to ``level`` magnitude levels."""
+    scale = jnp.maximum(jnp.max(jnp.abs(flat)), 1e-12)
+    return _sq_round(flat, scale, key, level), scale
 
 
 def qsgd_quantize_dequantize(x: jnp.ndarray, key: jax.Array, level: int) -> jnp.ndarray:
@@ -116,6 +161,31 @@ def _sq_decode_leaf(packed, packed_signs, scale, level: int, bits: int, n: int):
     return magnitude * (1.0 - 2.0 * signs)
 
 
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _sq_encode_flat(vec, seg_ids, key, level: int, bits: int, num_segments: int):
+    """Whole-model QSGD as ONE program with PER-TENSOR scales: the abs-max
+    scale is a segment reduction over the layout, so a layernorm bias is
+    never quantized against an embedding's magnitude (a single global
+    scale would bury small tensors in rounding noise)."""
+    flat = vec.astype(jnp.float32)
+    seg_scales = jax.ops.segment_max(
+        jnp.abs(flat), seg_ids, num_segments=num_segments
+    )
+    seg_scales = jnp.maximum(seg_scales, 1e-12)
+    q = _sq_round(flat, seg_scales[seg_ids], key, level)
+    packed = _pack_uint(q.astype(jnp.uint32), bits)
+    packed_signs = _pack_uint((flat < 0).astype(jnp.uint32), 1)
+    return packed, packed_signs, seg_scales
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6))
+def _sq_decode_flat(packed, packed_signs, seg_scales, seg_ids, level: int, bits: int, n: int):
+    q = _unpack_uint(packed, bits, n).astype(jnp.float32)
+    signs = _unpack_uint(packed_signs, 1, n).astype(jnp.float32)
+    magnitude = q / level * seg_scales[seg_ids]
+    return magnitude * (1.0 - 2.0 * signs)
+
+
 def stochastic_quantization(quantization_level: int = 255, use_pallas: bool | None = None):
     """Return ``(quant, dequant)`` closures over pytrees (reference surface:
     ``stochastic_quantization(quantization_level=255)``).
@@ -130,8 +200,20 @@ def stochastic_quantization(quantization_level: int = 255, use_pallas: bool | No
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
 
-    def quant(tree: Any, seed: int = 0, key=None, fold_indices=None) -> dict:
-        """``key`` (a jax PRNGKey) overrides the integer seed: per-leaf
+    def quant(
+        tree: Any, seed: int = 0, key=None, fold_indices=None, flat: bool = False
+    ) -> dict:
+        """``flat=True`` is the ParamVec entry point: the whole param dict
+        is encoded as ONE flat vector leaf — one packed stream, one
+        dispatch instead of one per tensor — while the abs-max scales
+        stay PER TENSOR (a segment reduction over the layout), so flat
+        encoding does not change the codec's distortion profile.  The
+        layout rides in the blob for decode.  Ignored when an aligned
+        ``key`` is supplied: the cross-executor parity rules (fed_paq
+        split-per-leaf, fed_obd_sq fold-by-position) are defined per
+        tensor.
+
+        ``key`` (a jax PRNGKey) overrides the integer seed: per-leaf
         keys come from ``split(key, n_leaves)`` — EXACTLY the stream the
         SPMD in-program codec draws (``parallel/spmd.py`` local_train),
         which is what cross-executor fed_paq codec parity needs.  With
@@ -144,6 +226,33 @@ def stochastic_quantization(quantization_level: int = 255, use_pallas: bool | No
         encoder."""
         from . import pallas_kernels as pk
 
+        if flat and key is None and _flat_encodable(tree):
+            vec_tree, layout = _flat_encode_tree(tree)
+            seg_ids = _segment_ids(layout)
+            packed, packed_signs, seg_scales = _sq_encode_flat(
+                vec_tree[_FLAT_KEY],
+                seg_ids,
+                jax.random.PRNGKey(seed),
+                quantization_level,
+                bits,
+                len(layout.keys),
+            )
+            _, treedef = jax.tree.flatten(vec_tree)
+            return {
+                "treedef": treedef,
+                "leaves": [
+                    {
+                        "packed": packed,
+                        "signs": packed_signs,
+                        "scales": seg_scales,  # [T] per-tensor abs-max
+                        "shape": (layout.size,),
+                        "dtype": "float32",
+                        "pallas": False,
+                    }
+                ],
+                "level": quantization_level,
+                "flat_layout": layout,
+            }
         leaves, treedef = jax.tree.flatten(tree)
         if key is not None and fold_indices is not None:
             names = sorted(tree) if isinstance(tree, dict) else []
@@ -194,9 +303,16 @@ def stochastic_quantization(quantization_level: int = 255, use_pallas: bool | No
         from . import pallas_kernels as pk
 
         decoded = []
+        flat_layout = blob.get("flat_layout")
         for enc in blob["leaves"]:
             n = int(np.prod(enc["shape"])) if enc["shape"] else 1
-            if enc.get("pallas"):
+            if "scales" in enc:
+                flat = _sq_decode_flat(
+                    enc["packed"], enc["signs"], enc["scales"],
+                    _segment_ids(flat_layout),
+                    blob["level"], bits, n,
+                )
+            elif enc.get("pallas"):
                 flat = pk.qsgd_decode(
                     enc["packed"], enc["signs"], enc["scale"],
                     level=blob["level"], bits=bits, n=n,
@@ -206,7 +322,11 @@ def stochastic_quantization(quantization_level: int = 255, use_pallas: bool | No
                     enc["packed"], enc["signs"], enc["scale"], blob["level"], bits, n
                 )
             decoded.append(flat.reshape(enc["shape"]).astype(enc["dtype"]))
-        return jax.tree.unflatten(blob["treedef"], decoded)
+        tree = jax.tree.unflatten(blob["treedef"], decoded)
+        layout = blob.get("flat_layout")
+        if layout is not None:
+            return _flat_decode_tree(tree, layout)
+        return tree
 
     return quant, dequant
 
@@ -254,7 +374,16 @@ class NNADQ:
         # parameter delta stalls FedOBD value uploads
         return int(min(16, max(2, round(b))))
 
-    def quant(self, tree: Any) -> dict:
+    def quant(self, tree: Any, flat: bool = False) -> dict:
+        """``flat=True``: ParamVec entry point — one bit-width chosen from
+        the whole vector's stats, one packed stream (collapses the
+        per-tensor dispatch count; trades away per-tensor bit adaptivity,
+        which is why the NNADQ endpoints keep per-tensor by default)."""
+        if flat and _flat_encodable(tree):
+            vec_tree, layout = _flat_encode_tree(tree)
+            blob = self.quant(vec_tree)
+            blob["flat_layout"] = layout
+            return blob
         leaves, treedef = jax.tree.flatten(tree)
         stds = [float(jnp.std(jnp.asarray(leaf))) for leaf in leaves]
         encoded = []
@@ -280,7 +409,11 @@ class NNADQ:
             n = int(np.prod(enc["shape"])) if enc["shape"] else 1
             flat = _adq_decode_leaf(enc["packed"], enc["lo"], enc["span"], enc["bits"], n)
             decoded.append(flat.reshape(enc["shape"]).astype(enc["dtype"]))
-        return jax.tree.unflatten(blob["treedef"], decoded)
+        tree = jax.tree.unflatten(blob["treedef"], decoded)
+        layout = blob.get("flat_layout")
+        if layout is not None:
+            return _flat_decode_tree(tree, layout)
+        return tree
 
     def __call__(self, tree: Any) -> dict:
         return self.quant(tree)
@@ -295,5 +428,8 @@ def check_compression_ratio(original: Any, encoded: dict) -> float:
         for key in ("packed", "signs"):
             if key in enc:
                 encoded_bytes += int(enc[key].nbytes)
-        encoded_bytes += 8  # scales/offsets
+        if "scales" in enc:  # flat ParamVec leaf: [T] per-tensor scales
+            encoded_bytes += int(enc["scales"].nbytes)
+        else:
+            encoded_bytes += 8  # scalar scale/offset
     return encoded_bytes / original_bytes

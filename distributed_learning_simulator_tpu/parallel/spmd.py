@@ -166,6 +166,15 @@ def scan_local_epochs_carry(
     engine, epochs: int, global_params, data, rng, opt_state=None,
     val_data=None,
 ):
+    # best-params mode (val_data) returns the FINAL epoch's opt_state, which
+    # does not correspond to the returned best-epoch params — combining it
+    # with opt-state continuation (reuse_learning_rate semantics, FedOBD
+    # phase 2) would resume momentum from the wrong trajectory point
+    assert opt_state is None or val_data is None, (
+        "scan_local_epochs_carry: opt_state continuation cannot be combined "
+        "with the best-params-by-validation policy (the returned opt_state "
+        "is the final epoch's, not the best epoch's)"
+    )
     if opt_state is None:
         opt_state = engine.optimizer.init(global_params)
     epoch_rngs = jax.random.split(rng, epochs)
@@ -403,7 +412,7 @@ class SpmdFedAvgSession:
         # per-client validation batches in-program for that.  Skipped when
         # a single epoch makes best == final (the in-round val eval is a
         # full extra forward per client), and for subclasses whose round
-        # programs do not consume it (OBD/sparse/Shapley).
+        # programs do not consume it (OBD/Shapley).
         self._val_data = None
         if (
             self._uses_val_policy

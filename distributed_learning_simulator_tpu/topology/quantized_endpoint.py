@@ -26,11 +26,18 @@ def _payload_field(message: Any) -> str | None:
 
 
 class _QuantCodecMixin:
-    """quantize on the way out, dequantize on the way in."""
+    """quantize on the way out, dequantize on the way in.
 
-    def _init_codec(self, name: str) -> None:
+    ``flat_payload`` routes whole-model payloads through the codec's
+    ParamVec entry point (``ops/quantization.py``): the param dict is
+    encoded as ONE flat vector — one codec dispatch per message instead of
+    one per tensor.  Aligned-key encodes (cross-executor codec parity)
+    always stay per-tensor; see ``_AlignedKeyMixin``."""
+
+    def _init_codec(self, name: str, flat_payload: bool = False) -> None:
         self._codec_name = name
         self._quant_seed = 0
+        self.flat_payload = bool(flat_payload)
         self.compression_ratios: list[float] = []
 
     def _quant(self, tree):  # subclass hook
@@ -88,9 +95,15 @@ class QuantClientEndpoint(_QuantCodecMixin, ClientEndpoint):
     (FedOBD turns it on together with the server's ``quant_broadcast``).
     """
 
-    def __init__(self, topology, worker_id, dequant_server_data: bool = True) -> None:
+    def __init__(
+        self,
+        topology,
+        worker_id,
+        dequant_server_data: bool = True,
+        flat_payload: bool = False,
+    ) -> None:
         ClientEndpoint.__init__(self, topology, worker_id)
-        self._init_codec(type(self).__name__)
+        self._init_codec(type(self).__name__, flat_payload=flat_payload)
         self.dequant_server_data = dequant_server_data
 
     def send(self, data: Any) -> None:
@@ -110,9 +123,11 @@ class QuantServerEndpoint(_QuantCodecMixin, ServerEndpoint):
     dequantizes worker uploads; optionally quantizes broadcasts
     (``quant_broadcast``)."""
 
-    def __init__(self, topology, quant_broadcast: bool = False) -> None:
+    def __init__(
+        self, topology, quant_broadcast: bool = False, flat_payload: bool = False
+    ) -> None:
         ServerEndpoint.__init__(self, topology)
-        self._init_codec(type(self).__name__)
+        self._init_codec(type(self).__name__, flat_payload=flat_payload)
         self.quant_broadcast = quant_broadcast
 
     def get(self, worker_id: int, timeout: float | None = None) -> Any:
@@ -156,9 +171,12 @@ class _AlignedKeyMixin:
 
 class StochasticQuantClientEndpoint(_AlignedKeyMixin, QuantClientEndpoint):
     """QSGD stochastic quantization, 255 levels (reference
-    ``quantized_endpoint.py:74-78``)."""
+    ``quantized_endpoint.py:74-78``).  Defaults to the flat ParamVec
+    payload (one encode dispatch per upload); aligned-key encodes keep the
+    per-leaf rule, and ``endpoint_kwargs.flat_payload: false`` opts out."""
 
     def __init__(self, topology, worker_id, quantization_level: int = 255, **kwargs):
+        kwargs.setdefault("flat_payload", True)
         super().__init__(topology, worker_id, **kwargs)
         self._q, self._dq = stochastic_quantization(quantization_level)
 
@@ -167,7 +185,11 @@ class StochasticQuantClientEndpoint(_AlignedKeyMixin, QuantClientEndpoint):
         if key is not None:
             return self._q(tree, key=key, fold_indices=fold)
         self._quant_seed += 1
-        return self._q(tree, seed=self._quant_seed * 2 + self.worker_id)
+        return self._q(
+            tree,
+            seed=self._quant_seed * 2 + self.worker_id,
+            flat=self.flat_payload,
+        )
 
     def _dequant(self, blob):
         return self._dq(blob)
@@ -175,6 +197,7 @@ class StochasticQuantClientEndpoint(_AlignedKeyMixin, QuantClientEndpoint):
 
 class StochasticQuantServerEndpoint(_AlignedKeyMixin, QuantServerEndpoint):
     def __init__(self, topology, quantization_level: int = 255, **kwargs):
+        kwargs.setdefault("flat_payload", True)
         super().__init__(topology, **kwargs)
         self._q, self._dq = stochastic_quantization(quantization_level)
 
@@ -183,7 +206,7 @@ class StochasticQuantServerEndpoint(_AlignedKeyMixin, QuantServerEndpoint):
         if key is not None:
             return self._q(tree, key=key, fold_indices=fold)
         self._quant_seed += 1
-        return self._q(tree, seed=self._quant_seed * 2 + 1)
+        return self._q(tree, seed=self._quant_seed * 2 + 1, flat=self.flat_payload)
 
     def _dequant(self, blob):
         return self._dq(blob)
@@ -191,14 +214,18 @@ class StochasticQuantServerEndpoint(_AlignedKeyMixin, QuantServerEndpoint):
 
 class NNADQClientEndpoint(QuantClientEndpoint):
     """Adaptive deterministic quantization with tunable ``weight`` from
-    ``endpoint_kwargs`` (reference ``quantized_endpoint.py:86-101``)."""
+    ``endpoint_kwargs`` (reference ``quantized_endpoint.py:86-101``).
+
+    Per-tensor by default — NNADQ's value IS its per-tensor bit-width
+    adaptivity; ``endpoint_kwargs.flat_payload: true`` trades it for one
+    whole-model encode dispatch."""
 
     def __init__(self, topology, worker_id, weight: float = 0.01, **kwargs):
         super().__init__(topology, worker_id, **kwargs)
         self._codec = NNADQ(weight=weight)
 
     def _quant(self, tree):
-        return self._codec.quant(tree)
+        return self._codec.quant(tree, flat=self.flat_payload)
 
     def _dequant(self, blob):
         return self._codec.dequant(blob)
@@ -212,7 +239,7 @@ class NNADQServerEndpoint(QuantServerEndpoint):
         self._codec = NNADQ(weight=weight)
 
     def _quant(self, tree):
-        return self._codec.quant(tree)
+        return self._codec.quant(tree, flat=self.flat_payload)
 
     def _dequant(self, blob):
         return self._codec.dequant(blob)

@@ -22,6 +22,15 @@ def test_bench_main_prints_one_json_line(monkeypatch):
     monkeypatch.setattr(
         bench, "measure_large_scale", lambda: {"value": 0.2}
     )
+    monkeypatch.setattr(
+        bench,
+        "measure_aggregation",
+        lambda: {
+            "agg_path": "flat",
+            "flat_s_per_round": 0.01,
+            "per_tensor_s_per_round": 0.05,
+        },
+    )
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     bench.main()
@@ -37,10 +46,15 @@ def test_bench_main_prints_one_json_line(monkeypatch):
         "dense_shape",
         "long_context",
         "large_scale",
+        "agg_path",
+        "aggregation",
         "headline_explained",
     ):
         assert field in payload, field
     assert payload["metric"] == "fedavg_cifar10_100clients_rounds_per_sec"
+    assert payload["agg_path"] in ("flat", "per_tensor")
+    # aggregation wall time is reported per round, separately per path
+    assert "flat_s_per_round" in payload["aggregation"]
 
 
 def test_bench_main_survives_measurement_failures(monkeypatch):
@@ -56,6 +70,7 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     monkeypatch.setattr(bench, "measure_vit", boom)
     monkeypatch.setattr(bench, "measure_long_context", boom)
     monkeypatch.setattr(bench, "measure_large_scale", boom)
+    monkeypatch.setattr(bench, "measure_aggregation", boom)
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     bench.main()
@@ -64,3 +79,6 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     assert payload["vs_baseline"] == 0.0
     assert "error" in payload["long_context"]
     assert "error" in payload["large_scale"]
+    # agg_path still records the default path even when timing it failed
+    assert payload["agg_path"] == "flat"
+    assert "error" in payload["aggregation"]

@@ -1,0 +1,373 @@
+"""The ParamVec flat aggregation pipeline (server hot path).
+
+Pins the tentpole contracts:
+
+* numeric parity — flat-vector streaming AND batch aggregation match the
+  per-tensor walk to fp32 tolerance, leaf shapes/dtypes preserved;
+* ``float64_parity`` mode is untouched by the flat path;
+* dispatch count — streaming accumulation issues exactly ONE jitted call
+  per upload (the donated fused add) and never retraces across uploads
+  with distinct weights (``_cache_size() == 1``);
+* the codec ParamVec entry points round-trip with the layout restored.
+"""
+
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_learning_simulator_tpu.algorithm.aggregation_algorithm import (
+    AggregationAlgorithm,
+)
+from distributed_learning_simulator_tpu.algorithm.fed_avg_algorithm import (
+    FedAVGAlgorithm,
+)
+from distributed_learning_simulator_tpu.message import ParameterMessage
+from distributed_learning_simulator_tpu.ops import pytree
+
+
+def _upload_params(rng, scale=1.0):
+    return {
+        "block_1/conv/kernel": jnp.asarray(
+            rng.normal(size=(3, 3, 8, 16)).astype(np.float32) * scale
+        ),
+        "block_1/conv/bias": jnp.asarray(rng.normal(size=(16,)).astype(np.float32)),
+        "head/dense/kernel": jnp.asarray(
+            rng.normal(size=(64, 10)).astype(np.float32) * scale
+        ),
+        "head/dense/bias": jnp.asarray(rng.normal(size=(10,)).astype(np.float32)),
+        "scalar/temperature": jnp.asarray(np.float32(rng.normal())),
+    }
+
+
+def _uploads(n=5, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(_upload_params(rng, scale=1.0 + 0.3 * i), 16 + 7 * i) for i in range(n)]
+
+
+def _config(**algorithm_kwargs):
+    return types.SimpleNamespace(algorithm_kwargs=algorithm_kwargs)
+
+
+def _stream(uploads, **algorithm_kwargs):
+    algorithm = FedAVGAlgorithm()
+    algorithm.set_config(_config(**algorithm_kwargs))
+    for worker_id, (params, size) in enumerate(uploads):
+        algorithm.process_worker_data(
+            worker_id, ParameterMessage(parameter=dict(params), dataset_size=size)
+        )
+    return algorithm, algorithm.aggregate_worker_data().parameter
+
+
+def test_layout_roundtrip_mixed_dtypes():
+    rng = np.random.default_rng(1)
+    params = {
+        "a/kernel": jnp.asarray(rng.normal(size=(4, 3)).astype(np.float32)),
+        "b/embed": jnp.asarray(rng.normal(size=(7,)).astype(np.float32)).astype(
+            jnp.bfloat16
+        ),
+        "c/scalar": jnp.float32(2.5),
+    }
+    layout = pytree.ParamVecLayout.of(params)
+    assert layout.keys == ("a/kernel", "b/embed", "c/scalar")
+    assert layout.size == 12 + 7 + 1
+    vec = pytree.flatten_params(params)
+    assert vec.shape == (layout.size,) and vec.dtype == jnp.float32
+    back = pytree.split_flat_params(vec, layout)
+    for key, value in params.items():
+        assert back[key].shape == value.shape
+        assert back[key].dtype == value.dtype
+        np.testing.assert_allclose(
+            np.asarray(back[key], np.float32),
+            np.asarray(value, np.float32),
+            rtol=1e-2 if value.dtype == jnp.bfloat16 else 1e-7,
+        )
+    # the layout names the owner of any vector position (finite-check errors)
+    assert layout.key_at(0) == "a/kernel"
+    assert layout.key_at(12) == "b/embed"
+    assert layout.key_at(19) == "c/scalar"
+
+
+def test_streaming_flat_matches_per_tensor():
+    uploads = _uploads()
+    algorithm_flat, flat = _stream(uploads)
+    _, per_tensor = _stream(uploads, flat_aggregation=False)
+    assert set(flat) == set(per_tensor)
+    for key in flat:
+        assert flat[key].dtype == per_tensor[key].dtype
+        assert flat[key].shape == per_tensor[key].shape
+        np.testing.assert_allclose(
+            np.asarray(flat[key]), np.asarray(per_tensor[key]), rtol=2e-6, atol=1e-7
+        )
+    # the flat state was actually exercised (and finalized away)
+    assert algorithm_flat._vec_layout is not None
+    assert algorithm_flat._vec_acc is None
+
+
+def test_streaming_flat_matches_host_f64_stream():
+    """Against the reference-semantics accumulator, not just the old code."""
+    uploads = _uploads(n=6, seed=3)
+    _, flat = _stream(uploads)
+    keys = sorted(uploads[0][0])
+    acc = np.zeros(
+        sum(int(np.prod(p.shape)) if p.shape else 1 for p in uploads[0][0].values()),
+        np.float64,
+    )
+    total = 0.0
+    for params, size in uploads:
+        vec = np.concatenate(
+            [np.asarray(params[k], np.float32).ravel() for k in keys]
+        ).astype(np.float64)
+        acc += vec * float(size)
+        total += float(size)
+    ref = acc / total
+    got = np.concatenate([np.asarray(flat[k], np.float32).ravel() for k in keys])
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-30)
+    assert rel <= 1e-6, rel
+
+
+def test_streaming_one_dispatch_per_upload_no_retrace():
+    uploads = _uploads(n=8, seed=5)
+    calls = {"acc": 0, "first": 0, "per_tensor": 0}
+    real_acc_add = pytree.flat_acc_add
+    real_first = pytree.flat_weighted_vec
+
+    def counting_acc(*args, **kwargs):
+        calls["acc"] += 1
+        return real_acc_add(*args, **kwargs)
+
+    def counting_first(*args, **kwargs):
+        calls["first"] += 1
+        return real_first(*args, **kwargs)
+
+    from distributed_learning_simulator_tpu.algorithm import fed_avg_algorithm
+
+    per_tensor_add = fed_avg_algorithm._acc_add
+    cache_before = real_acc_add._cache_size()
+    try:
+        pytree.flat_acc_add = counting_acc
+        pytree.flat_weighted_vec = counting_first
+        fed_avg_algorithm._acc_add = lambda *a, **k: calls.__setitem__(
+            "per_tensor", calls["per_tensor"] + 1
+        ) or per_tensor_add(*a, **k)
+        _, result = _stream(uploads)
+    finally:
+        pytree.flat_acc_add = real_acc_add
+        pytree.flat_weighted_vec = real_first
+        fed_avg_algorithm._acc_add = per_tensor_add
+    assert result
+    # O(1) jitted dispatches per upload: one flatten·w for the first, one
+    # donated fused add per subsequent upload, zero per-tensor walks
+    assert calls["first"] == 1
+    assert calls["acc"] == len(uploads) - 1
+    assert calls["per_tensor"] == 0
+    # 7 uploads with 7 distinct weights compiled at most ONE new program
+    # (the weight rides as a traced scalar — no retrace per value)
+    assert real_acc_add._cache_size() - cache_before <= 1
+    # and the fused add really is one program: a single (p)jit equation
+    sample = {k: jnp.zeros_like(v) for k, v in uploads[0][0].items()}
+    acc = jnp.zeros(
+        (pytree.ParamVecLayout.of(sample).size,), jnp.float32
+    )
+    jaxpr = jax.make_jaxpr(lambda a, p: pytree.flat_acc_add(a, p, 2.0))(acc, sample)
+    assert len(jaxpr.eqns) == 1, jaxpr
+
+
+def test_streaming_flat_donates_accumulator():
+    uploads = _uploads(n=3, seed=7)
+    algorithm = FedAVGAlgorithm()
+    algorithm.set_config(_config())
+    handles = []
+    for worker_id, (params, size) in enumerate(uploads):
+        algorithm.process_worker_data(
+            worker_id, ParameterMessage(parameter=dict(params), dataset_size=size)
+        )
+        handles.append(algorithm._vec_acc)
+    # every pre-final accumulator buffer was consumed in place by XLA
+    assert all(h.is_deleted() for h in handles[:-1])
+    algorithm.aggregate_worker_data()
+
+
+def test_batch_weighted_avg_matches_per_tensor_reference():
+    uploads = _uploads(n=4, seed=11)
+    messages = {
+        w: ParameterMessage(parameter=dict(params), dataset_size=size)
+        for w, (params, size) in enumerate(uploads)
+    }
+    weights = AggregationAlgorithm.get_ratios(
+        {w: d.dataset_size for w, d in messages.items()}
+    )
+    got = AggregationAlgorithm.weighted_avg(messages, weights)
+    # the pre-ParamVec per-tensor walk, inlined as the reference
+    first = messages[0].parameter
+    for name in first:
+        acc = None
+        for w in sorted(messages):
+            term = messages[w].parameter[name].astype(jnp.float32) * weights[w]
+            acc = term if acc is None else acc + term
+        ref = acc.astype(first[name].dtype)
+        assert got[name].dtype == ref.dtype
+        np.testing.assert_allclose(
+            np.asarray(got[name], np.float32),
+            np.asarray(ref, np.float32),
+            rtol=2e-6,
+            atol=1e-7,
+        )
+
+
+def test_float64_parity_mode_untouched():
+    uploads = _uploads(n=4, seed=13)
+    server = types.SimpleNamespace(config=_config(float64_parity=True))
+    algorithm = FedAVGAlgorithm(server=server)
+    assert not algorithm._flat_path
+    real_acc_add = pytree.flat_acc_add
+    calls = {"flat": 0}
+    try:
+        pytree.flat_acc_add = lambda *a, **k: calls.__setitem__(
+            "flat", calls["flat"] + 1
+        ) or real_acc_add(*a, **k)
+        for worker_id, (params, size) in enumerate(uploads):
+            algorithm.process_worker_data(
+                worker_id,
+                ParameterMessage(parameter=dict(params), dataset_size=size),
+            )
+        assert algorithm._f64_acc, "f64 parity mode must use the native accumulator"
+        result = algorithm.aggregate_worker_data().parameter
+    finally:
+        pytree.flat_acc_add = real_acc_add
+    assert calls["flat"] == 0
+    _, flat = _stream(uploads)
+    for key in result:
+        np.testing.assert_allclose(
+            np.asarray(result[key]), np.asarray(flat[key]), rtol=2e-6, atol=1e-7
+        )
+
+
+def test_subclass_weight_hooks_keep_per_tensor_path():
+    from distributed_learning_simulator_tpu.method.fed_dropout_avg.algorithm import (
+        FedDropoutAvgAlgorithm,
+    )
+
+    algorithm = FedDropoutAvgAlgorithm()
+    algorithm.set_config(_config())
+    assert not algorithm._flat_path
+
+
+def test_weighted_sum_matches_manual():
+    uploads = _uploads(n=3, seed=17)
+    param_list = [params for params, _ in uploads]
+    weights = [0.2, 0.3, 0.5]
+    got = pytree.weighted_sum(param_list, weights)
+    for key in param_list[0]:
+        ref = sum(
+            np.asarray(p[key], np.float32) * w for p, w in zip(param_list, weights)
+        )
+        assert got[key].dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(got[key]), ref, rtol=2e-6, atol=1e-7)
+
+
+def test_flat_finite_check_names_parameter():
+    uploads = _uploads(n=2, seed=19)
+    bad = dict(uploads[1][0])
+    bad["head/dense/kernel"] = bad["head/dense/kernel"].at[0, 0].set(jnp.nan)
+    algorithm = FedAVGAlgorithm()
+    algorithm.set_config(_config())
+    algorithm.process_worker_data(
+        0, ParameterMessage(parameter=dict(uploads[0][0]), dataset_size=8)
+    )
+    algorithm.process_worker_data(1, ParameterMessage(parameter=bad, dataset_size=8))
+    with pytest.raises(FloatingPointError, match="head/dense/kernel"):
+        algorithm.aggregate_worker_data()
+
+
+def test_codec_flat_entry_points_roundtrip():
+    from distributed_learning_simulator_tpu.ops.quantization import (
+        NNADQ,
+        stochastic_quantization,
+    )
+
+    rng = np.random.default_rng(23)
+    tree = _upload_params(rng)
+    # a tiny-magnitude tensor next to a large one: flat encoding must keep
+    # PER-TENSOR scales (a global abs-max would bury the small tensor)
+    tree["tiny/scale"] = jnp.asarray(
+        rng.normal(size=(32,)).astype(np.float32) * 1e-3
+    )
+    tree["huge/embed"] = jnp.asarray(
+        rng.normal(size=(64,)).astype(np.float32) * 50.0
+    )
+    quant, dequant = stochastic_quantization(255)
+    blob = quant(tree, seed=3, flat=True)
+    assert len(blob["leaves"]) == 1  # ONE encoded stream for the whole model
+    assert blob["flat_layout"].matches(tree)
+    back = dequant(blob)
+    for key, value in tree.items():
+        assert back[key].shape == value.shape and back[key].dtype == value.dtype
+    tiny_err = np.abs(
+        np.asarray(back["tiny/scale"]) - np.asarray(tree["tiny/scale"])
+    ).max()
+    # per-tensor scale ⇒ error bounded by the TINY tensor's own step, three
+    # orders of magnitude below the huge tensor's (global-scale would give
+    # ~50/255 ≈ 0.2 here)
+    assert tiny_err <= 2 * np.abs(np.asarray(tree["tiny/scale"])).max() / 255
+    for key, value in tree.items():
+        step = np.abs(np.asarray(value)).max() / 255 + 1e-12
+        np.testing.assert_allclose(
+            np.asarray(back[key]), np.asarray(value), atol=2 * step
+        )
+
+    codec = NNADQ(weight=0.01)
+    blob = codec.quant(tree, flat=True)
+    assert len(blob["leaves"]) == 1
+    back = codec.dequant(blob)
+    for key, value in tree.items():
+        assert back[key].shape == value.shape and back[key].dtype == value.dtype
+        np.testing.assert_allclose(
+            np.asarray(back[key]), np.asarray(value), atol=0.2
+        )
+    # an aligned key forces the per-leaf rule (cross-executor parity)
+    keyed = quant(tree, key=jax.random.PRNGKey(0), flat=True)
+    assert "flat_layout" not in keyed
+    assert len(keyed["leaves"]) == len(tree)
+
+
+def test_engine_donated_epoch_matches_and_frees(tmp_session_dir):
+    from conftest import fed_avg_config
+
+    from distributed_learning_simulator_tpu.engine.batching import make_epoch_batches
+    from distributed_learning_simulator_tpu.engine.engine import ComputeEngine
+    from distributed_learning_simulator_tpu.ml_type import (
+        MachineLearningPhase as Phase,
+    )
+    from distributed_learning_simulator_tpu.training import _build_task
+
+    ctx = _build_task(fed_avg_config())
+    engine = ctx.engine
+    donated = ComputeEngine(
+        engine.model_ctx, engine.hyper_parameter, engine.total_steps
+    )
+    donated.donate_buffers = True
+    batches = make_epoch_batches(
+        ctx.dataset_collection.get_dataset(Phase.Training),
+        engine.hyper_parameter.batch_size,
+        None,
+    )
+    rng = jax.random.PRNGKey(0)
+
+    params_a = engine.init_params(0)
+    out_a = engine.train_epoch(params_a, engine.init_opt_state(params_a), batches, rng)
+
+    params_b = donated.init_params(0)
+    opt_b = donated.init_opt_state(params_b)
+    out_b = donated.train_epoch(params_b, opt_b, batches, rng)
+
+    for leaf_a, leaf_b in zip(jax.tree.leaves(out_a), jax.tree.leaves(out_b)):
+        np.testing.assert_allclose(
+            np.asarray(leaf_a), np.asarray(leaf_b), rtol=1e-6, atol=1e-7
+        )
+    # opt-in donation really released the incoming buffers
+    assert any(leaf.is_deleted() for leaf in jax.tree.leaves(params_b))
+    # the default engine kept its inputs alive (threaded caches rely on it)
+    assert not any(leaf.is_deleted() for leaf in jax.tree.leaves(params_a))
