@@ -56,6 +56,8 @@ def make_config(
     tag: str = "",
     dataset_name: str = "CIFAR10",
     dataset_extra: dict | None = None,
+    rounds: int = 1,
+    use_amp: bool = True,  # canonical large_scale configuration (bf16 MXU)
     **extra,
 ):
     from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
@@ -68,10 +70,10 @@ def make_config(
         executor=executor,
         worker_number=workers,
         batch_size=batch_size,
-        round=1,
+        round=rounds,
         epoch=EPOCH,
         learning_rate=0.1,
-        use_amp=True,  # the canonical large_scale configuration (bf16 MXU)
+        use_amp=use_amp,
         dataset_kwargs={
             "train_size": train_size,
             "val_size": 64,
@@ -233,6 +235,74 @@ def measure_large_scale() -> dict:
     except Exception:
         pass
     return entry
+
+
+# dispatch-budget guard: the small-model round-horizon matrix.  For
+# LeNet5/MNIST-scale clients the HOST control loop (per-round dispatch,
+# eval fetch, record write), not the chip, bounds rounds/sec — exactly the
+# shape round_horizon fuses away.  Measures full session.run() loops (a
+# warmup run compiles; the timed run reuses the session's jitted programs)
+# and reports rounds/sec plus the session's dispatch/host-sync counters so
+# the driver can pin dispatches_per_round <= 1/H + eps.
+# 16 rounds (2 fused chunks at H=8): one chunk alone under-amortizes the
+# horizon loop's per-chunk edges (weight matrix build, boundary
+# checkpoint) and under-states the fused win on fast backends
+HZ_WORKERS = 8
+HZ_ROUNDS = 16
+HZ_HORIZON = 8
+HZ_BATCH = 16
+
+
+def measure_round_horizon() -> dict:
+    import jax
+
+    from distributed_learning_simulator_tpu.parallel.spmd import (
+        SpmdFedAvgSession,
+    )
+    from distributed_learning_simulator_tpu.training import _build_task
+
+    out: dict = {
+        "model": "LeNet5/MNIST",
+        "workers": HZ_WORKERS,
+        "rounds": HZ_ROUNDS,
+        "horizon": HZ_HORIZON,
+    }
+    for h in (1, HZ_HORIZON):
+        config = make_config(
+            "spmd",
+            HZ_WORKERS,
+            HZ_WORKERS * HZ_BATCH,
+            model_name="LeNet5",
+            batch_size=HZ_BATCH,
+            tag=f"horizon{h}",
+            dataset_name="MNIST",
+            rounds=HZ_ROUNDS,
+            use_amp=False,  # the canonical LeNet5/MNIST config is fp32
+            algorithm_kwargs={"round_horizon": h},
+        )
+        ctx = _build_task(config)
+        session = SpmdFedAvgSession(
+            ctx.config,
+            ctx.dataset_collection,
+            ctx.model_ctx,
+            ctx.engine,
+            ctx.practitioners,
+        )
+        session.run()  # warmup: compiles the round/horizon programs
+        session._stat.clear()
+        session.reset_dispatch_stats()
+        start = time.monotonic()
+        session.run()
+        elapsed = time.monotonic() - start
+        out[f"h{h}"] = {
+            "rounds_per_sec": round(HZ_ROUNDS / elapsed, 4),
+            "dispatches_per_round": round(session.dispatches_per_round, 4),
+            "host_sync_points": round(session.host_sync_points, 4),
+        }
+    h1, hH = out["h1"], out[f"h{HZ_HORIZON}"]
+    if h1["rounds_per_sec"]:
+        out["speedup"] = round(hH["rounds_per_sec"] / h1["rounds_per_sec"], 3)
+    return out
 
 
 # server-side aggregation microbench: the ParamVec flat path vs the
@@ -548,6 +618,13 @@ def main() -> None:
         aggregation = measure_aggregation()
     except Exception as exc:
         aggregation = {"agg_path": "flat", "error": str(exc)[:200]}
+    # dispatch-budget guard: round-horizon fusion on the small-model shape
+    # (host-bound), with the session's dispatch/host-sync counters
+    try:
+        dispatch_budget = measure_round_horizon()
+    except Exception as exc:
+        dispatch_budget = {"error": str(exc)[:200]}
+    fused = dispatch_budget.get(f"h{HZ_HORIZON}", {})
     # canonical north-star workloads (VERDICT r4 item 7): full
     # gtg_shapley_train.sh / fed_obd_train.sh runs are ~1 h on-chip, so
     # they are measured once per machine by tools/run_canonical.py and
@@ -592,6 +669,13 @@ def main() -> None:
                 # walk) + its isolated wall time per round
                 "agg_path": aggregation.get("agg_path", "flat"),
                 "aggregation": aggregation,
+                # dispatch-budget guard: jitted dispatches and blocking
+                # host fetches per round under round_horizon fusion (the
+                # headline pair comes from the fused H run; the full
+                # H=1-vs-H matrix is in dispatch_budget)
+                "dispatches_per_round": fused.get("dispatches_per_round", 0.0),
+                "host_sync_points": fused.get("host_sync_points", 0.0),
+                "dispatch_budget": dispatch_budget,
                 "canonical": canonical,
             }
         )

@@ -70,6 +70,14 @@ class DistributedTrainingConfig:
     executor: str = "auto"  # auto | spmd | sequential
     save_dir: str = ""
     checkpoint_every_round: bool = True
+    # round checkpoint cadence: write aggregated_model/round_N.npz every N
+    # rounds (the run's final round is always written so the exit state
+    # stays resumable).  0 = auto — every round for per-round dispatch
+    # (the legacy cadence), every horizon boundary when
+    # algorithm_kwargs.round_horizon fuses rounds.  Resume lands on the
+    # latest round with BOTH a checkpoint and a record row, so a sparse
+    # cadence simply re-trains the un-checkpointed tail.
+    checkpoint_every: int = 0
     profile: bool = False  # capture a jax profiler trace under save_dir/profile
     # stall watchdog for the threaded executor's message fabric: abort the
     # task when NO message moves for this many seconds (0 = disabled; size

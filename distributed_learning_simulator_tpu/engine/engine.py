@@ -131,6 +131,12 @@ class ComputeEngine:
         return params, opt_state, summed
 
     def eval_fn(self, params, batches):
+        """Summed eval metrics over scanned batches.  This is also THE
+        in-program evaluate: the horizon-fused SPMD sessions inline it
+        inside their round scans (one fetch of ``[H]``-stacked sums per
+        dispatch instead of a jitted eval per round) — keep it free of
+        host callbacks and Python-side state."""
+
         def body(carry, batch):
             loss, aux = self.model_ctx.loss(params, batch, train=False)
             carry = {
@@ -246,3 +252,18 @@ def summarize_metrics(summed: dict[str, Any]) -> dict[str, float]:
         "accuracy": float(summed["correct"]) / count,
         "count": count,
     }
+
+
+def stacked_round_metrics(stacked: dict[str, Any]) -> list[dict[str, float]]:
+    """Fan an ``[H]``-stacked summed-metrics tree (one ``eval_fn`` result
+    per fused round) out into one :func:`summarize_metrics` dict per round.
+    This is the horizon sessions' single host sync: ``np.asarray`` here
+    fetches the whole stack in one device→host transfer."""
+    import numpy as np
+
+    host = {k: np.asarray(v) for k, v in stacked.items()}
+    rounds = len(next(iter(host.values())))
+    return [
+        summarize_metrics({k: v[i] for k, v in host.items()})
+        for i in range(rounds)
+    ]

@@ -45,17 +45,29 @@ def aligned_round_stream(seed: int, round_number: int, worker_id: int):
     return jax.random.fold_in(round_rng, worker_id)
 
 
-def obd_aligned_round_stream(seed: int, aggregate_index: int, worker_id: int):
+def obd_aligned_round_stream(
+    seed: int, aggregate_index: int, worker_id: int, n_slots: int | None = None
+):
     """The FedOBD SPMD session's per-(aggregate, client) rng
     (``parallel/spmd_obd.py`` run loop: a THREE-way split chain —
     ``rng, round_rng, bcast_rng`` per aggregate — with client streams
-    from ``split(round_rng, n_slots)``; split prefixes are
-    slot-count-independent, so ``worker_id + 1`` suffices here)."""
+    from ``split(round_rng, n_slots)``).  ``n_slots`` must be the SPMD
+    session's PADDED slot count: split prefixes are NOT slot-count-
+    independent under jax's default non-partitionable threefry (a
+    ``split(k, 2)`` prefix differs from ``split(k, 8)[:2]``), so replaying
+    the stream needs the exact count the session split with.  When omitted
+    it is derived from the default mesh the session would build — the
+    slot count for ``worker_id + 1`` workers, correct whenever the worker
+    count does not exceed one mesh's slot padding."""
+    if n_slots is None:
+        from ..parallel.mesh import client_slots, make_mesh
+
+        n_slots = client_slots(worker_id + 1, make_mesh())
     rng = jax.random.PRNGKey(seed)
     round_rng = rng
     for _ in range(aggregate_index):
         rng, round_rng, _bcast = jax.random.split(rng, 3)
-    return jax.random.split(round_rng, worker_id + 1)[worker_id]
+    return jax.random.split(round_rng, n_slots)[worker_id]
 
 
 def obd_aligned_bcast_rng(seed: int, aggregate_index: int):

@@ -65,10 +65,19 @@ class FedOBDWorker(AggregationWorker):
         super()._before_round()
         if int(self.config.algorithm_kwargs.get("second_phase_epoch", 0)) == 1:
             from ...engine.executor import obd_aligned_round_stream
+            from ...parallel.mesh import client_slots, make_mesh
 
+            # pass the SPMD session's exact padded slot count: split
+            # prefixes are slot-count-dependent under non-partitionable
+            # threefry, so the replayed stream must split the same n
             self.trainer.set_round_stream(
                 obd_aligned_round_stream(
-                    self.config.seed, self._round_num, self.worker_id
+                    self.config.seed,
+                    self._round_num,
+                    self.worker_id,
+                    n_slots=client_slots(
+                        self.config.worker_number, make_mesh()
+                    ),
                 )
             )
 

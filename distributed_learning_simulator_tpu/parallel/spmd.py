@@ -20,7 +20,6 @@ The host keeps the reference's control surface: per-round selection,
 round_record.json, best-model artifact, early stop.
 """
 
-import json
 import os
 
 import jax
@@ -30,8 +29,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import DistributedTrainingConfig
 from ..engine.batching import fixed_size_partition
-from ..engine.engine import ComputeEngine, maybe_slow_metrics, summarize_metrics
+from ..engine.engine import (
+    ComputeEngine,
+    maybe_slow_metrics,
+    slow_metrics_from_confusion,
+    stacked_round_metrics,
+    summarize_metrics,
+)
 from ..ml_type import MachineLearningPhase as Phase
+from ..util.checkpoint import atomic_json_dump
 from ..utils.logging import get_logger
 from .mesh import client_slots, make_mesh, put_sharded
 
@@ -370,12 +376,54 @@ class SpmdFedAvgSession:
         self.client_chunk = client_chunk or int(
             config.algorithm_kwargs.get("client_chunk", 0)
         )
+        # round-horizon fusion (``algorithm_kwargs.round_horizon``): fuse H
+        # consecutive rounds into ONE jitted, donated ``lax.scan`` over
+        # rounds, with per-round test evaluation in-program — the host
+        # touches the device once per horizon instead of 3-4 times per
+        # round (selection weights are host-precomputed per horizon; the
+        # rng chain advances inside the program, bit-identical to the
+        # host-side H=1 chain).
+        self.round_horizon = max(
+            1, int(config.algorithm_kwargs.get("round_horizon", 1) or 1)
+        )
+        # checkpoint cadence: round_N.npz every N rounds (the final round
+        # always).  ``config.checkpoint_every`` 0 = auto: every round at
+        # H=1 (the legacy cadence), every horizon boundary under fusion.
+        self._checkpoint_every = max(
+            1,
+            int(getattr(config, "checkpoint_every", 0) or 0)
+            or self.round_horizon,
+        )
+        self._last_ckpt_round = 0
+        # round_record.json flush cadence (atomic tmp+rename writes; the
+        # record used to be fully rewritten via a non-atomic open EVERY
+        # round — O(rounds²) I/O on long runs).  Default: per round at
+        # H=1, per horizon under fusion; always flushed at run exit
+        # through the checkpoint writer's finalizer hook.
+        self._record_flush_every = max(
+            1,
+            int(config.algorithm_kwargs.get("record_flush_every", 0) or 0)
+            or self.round_horizon,
+        )
+        self._record_path: str | None = None
+        self._record_dirty = False
         self._stat: dict[int, dict] = {}
         self._max_acc = 0.0
+        #: accuracy high-water mark over PROMOTABLE (checkpointed) rounds
+        #: — kept separate from ``_max_acc`` so a better mid-horizon (or
+        #: un-checkpointed) round cannot permanently starve the
+        #: best_global_model.npz promotion of later boundary rounds
+        self._best_ckpt_acc = 0.0
         self._eval_batches = None  # device-resident, built on first eval
+        # dispatch-budget instrumentation (bench.py): jitted program
+        # launches and blocking device→host fetches issued by the run loop
+        self.dispatch_count = 0
+        self.host_sync_count = 0
+        self.rounds_run = 0
         from ..util.checkpoint import AsyncCheckpointWriter
 
         self._ckpt = AsyncCheckpointWriter()
+        self._ckpt.register_finalizer("round_record", self._flush_record)
         self._ckpt_queued_round: int | None = None
 
         self._data, self._dataset_sizes, self.n_batches = stack_client_data(
@@ -427,7 +475,37 @@ class SpmdFedAvgSession:
                     val, NamedSharding(self.mesh, self._slot_spec)
                 )
 
+        # per-client rng fold chain, device-resident end to end: the old
+        # path materialized the folded keys on host (``np.asarray`` of the
+        # vmapped fold_in) before re-uploading them — a device→host→device
+        # bounce on the round critical path.  The stream is bit-identical
+        # (same fold_in chain, just never fetched).
+        slot_indices = jnp.arange(self.n_slots)
+        self._fold_rngs = jax.jit(
+            lambda round_rng: jax.vmap(
+                lambda i: jax.random.fold_in(round_rng, i)
+            )(slot_indices),
+            out_shardings=self._client_sharding,
+        )
+        # horizon-fused weight rows: [H, n_slots] with rounds replicated
+        # and slots sharded like every other slot-stacked input
+        self._horizon_weight_sharding = NamedSharding(
+            self.mesh, P(None, *self._slot_spec)
+        )
+        #: un-jitted round program (global_params, weights, rngs, data,
+        #: val) -> (new_global, metrics) — set by the base
+        #: ``_build_round_fn`` so the horizon builder can scan it.
+        #: Subclasses with their own round functions leave it None and
+        #: cannot fuse rounds.
+        self._round_program_fn = None
+        self._horizon_fns: dict[int, object] = {}
         self._round_fn = self._build_round_fn()
+        if self.round_horizon > 1 and self._round_program_fn is None:
+            raise ValueError(
+                "round_horizon > 1 requires the base FedAvg round program;"
+                f" {type(self).__name__} builds its own round function —"
+                " run it with round_horizon=1"
+            )
 
     def _leaf_spec(self, shape, name: str = "") -> P:
         """FSDP layout rule: shard a param leaf's leading dim over the
@@ -441,11 +519,19 @@ class SpmdFedAvgSession:
         layout — multi-host aware: every process passes the FULL global
         array and ``put_sharded`` slices out each host's addressable
         shards; a plain device_put cannot target shards on non-addressable
-        devices."""
-        return {
+        devices.
+
+        The trailing on-device copy is load-bearing: ``device_put`` of an
+        aligned host numpy array (npz resume / warm start) ALIASES the
+        python-owned buffer on the cpu backend, and these params are the
+        round program's DONATED argument — XLA would reuse memory python
+        still owns (heap corruption, NaN trajectories after resume).  The
+        copy's outputs are XLA-allocated, so donation is safe."""
+        placed = {
             k: put_sharded(v, self._param_shardings[k])
             for k, v in params.items()
         }
+        return jax.tree.map(jnp.copy, placed)
 
     def _checkpointable(self, params):
         """A view of ``params`` safe to fetch on this host for the npz
@@ -617,6 +703,9 @@ class SpmdFedAvgSession:
                 out_specs=(self._param_specs, P()),
             )(global_params, data, val, weights, rngs)
 
+        # the horizon builder scans this same program — one trace, shared
+        # numerics with the per-round path
+        self._round_program_fn = round_program
         # donate the old global params: the round returns the new ones, so
         # XLA can reuse the buffer instead of holding both copies live
         jitted = jax.jit(round_program, donate_argnums=(0,))
@@ -629,6 +718,56 @@ class SpmdFedAvgSession:
                 global_params, weights, rngs, self._data, self._val_data or {}
             )
 
+        return fn
+
+    # ------------------------------------------------------------------
+    def _build_horizon_fn(self, horizon: int):
+        """``horizon`` consecutive rounds as ONE jitted, donated
+        ``lax.scan``: the carry is (global_params, rng chain), each step
+        splits the chain exactly like the host loop (so H=1 and H=8
+        trajectories are bit-identical), folds the per-slot client rngs
+        in-program, runs the SAME round program the per-round path jits,
+        and evaluates the fresh global on the device-resident test batches
+        — stacked ``[H, ...]`` metrics come back in one host fetch."""
+        engine = self.engine
+        n_slots = self.n_slots
+        round_program = self._round_program_fn
+        with_confusion = bool(self.config.use_slow_performance_metrics)
+
+        def horizon_program(global_params, rng, weight_rows, data, val, eval_batches):
+            def body(carry, weights):
+                params, rng = carry
+                rng, round_rng = jax.random.split(rng)
+                client_rngs = jax.vmap(
+                    lambda i: jax.random.fold_in(round_rng, i)
+                )(jnp.arange(n_slots))
+                params, train_metrics = round_program(
+                    params, weights, client_rngs, data, val
+                )
+                eval_summed = engine.eval_fn(params, eval_batches)
+                outs = (train_metrics, eval_summed)
+                if with_confusion:
+                    outs = outs + (engine.confusion_fn(params, eval_batches),)
+                return (params, rng), outs
+
+            (global_params, rng), outs = jax.lax.scan(
+                body, (global_params, rng), weight_rows, length=horizon
+            )
+            return (global_params, rng), outs
+
+        jitted = jax.jit(horizon_program, donate_argnums=(0, 1))
+
+        def fn(global_params, rng, weight_rows):
+            return jitted(
+                global_params,
+                rng,
+                weight_rows,
+                self._data,
+                self._val_data or {},
+                self._ensure_eval_batches(),
+            )
+
+        fn._jitted = jitted
         return fn
 
     def round_flops(self, global_params) -> float:
@@ -689,6 +828,9 @@ class SpmdFedAvgSession:
                 self._max_acc = max(
                     s["test_accuracy"] for s in self._stat.values()
                 )
+                # the restored best_global_model.npz (if any) is at most
+                # this good — only a better checkpointed round re-promotes
+                self._best_ckpt_acc = self._max_acc
                 get_logger().info("resumed from %s round %d", resume_dir, last)
                 return self._place_params(params), last + 1
         init_path = config.algorithm_kwargs.get("global_model_path")
@@ -711,6 +853,8 @@ class SpmdFedAvgSession:
     def run(self) -> dict:
         import time as _time
 
+        if self.round_horizon > 1:
+            return self._run_horizon()
         config = self.config
         global_params, start_round = self._init_global_params()
         save_dir = os.path.join(config.save_dir, "server")
@@ -718,6 +862,7 @@ class SpmdFedAvgSession:
         rng = jax.random.PRNGKey(config.seed)
         for _ in range(start_round - 1):  # resume: keep the rng stream aligned
             rng, _unused = jax.random.split(rng)
+        self._last_ckpt_round = start_round - 1
         param_mb = sum(
             int(np.prod(v.shape)) * 4 for v in jax.tree.leaves(global_params)
         ) / 1e6
@@ -734,15 +879,10 @@ class SpmdFedAvgSession:
                 # independent of slot padding / device count — the threaded
                 # executor derives the identical stream per worker
                 # (engine/executor.py::aligned_round_stream) and the
-                # cross-executor parity test pins fed_avg trajectories
-                client_rngs = put_sharded(
-                    np.asarray(
-                        jax.vmap(lambda i: jax.random.fold_in(round_rng, i))(
-                            jnp.arange(self.n_slots)
-                        )
-                    ),
-                    self._client_sharding,
-                )
+                # cross-executor parity test pins fed_avg trajectories.
+                # The chain stays device-resident (no host bounce).
+                client_rngs = self._fold_rngs(round_rng)
+                self.dispatch_count += 1
                 # old global_params are donated into the round program —
                 # any pending background fetch of them must finish first
                 self._ckpt.barrier()
@@ -753,18 +893,24 @@ class SpmdFedAvgSession:
                     phase="round",
                     round_number=round_number,
                 )
+                self.dispatch_count += 1
                 # queue the round checkpoint NOW so its device→host fetch
                 # and disk write overlap the test-set evaluation below
-                self._ckpt.save_npz(
-                    os.path.join(model_dir, f"round_{round_number}.npz"),
-                    self._checkpointable(global_params),
-                )
-                self._ckpt_queued_round = round_number
+                if self._should_checkpoint(round_number):
+                    self._ckpt.save_npz(
+                        os.path.join(model_dir, f"round_{round_number}.npz"),
+                        self._checkpointable(global_params),
+                    )
+                    self._ckpt_queued_round = round_number
+                    self._last_ckpt_round = round_number
                 metric = self._watchdog.call(
                     lambda gp=global_params: self._evaluate(gp),
                     phase="eval",
                     round_number=round_number,
                 )
+                self.dispatch_count += 1
+                self.host_sync_count += 1
+                self.rounds_run += 1
                 # same stat surface as the threaded server: analytic wire
                 # cost (what the aggregation consumed over ICI, priced at
                 # the reference's message sizes) + round wall time
@@ -784,7 +930,133 @@ class SpmdFedAvgSession:
                 )
         return {"performance": self._stat}
 
-    def _evaluate(self, global_params) -> dict:
+    def _run_horizon(self) -> dict:
+        """The fused run loop: ``round_horizon`` rounds per dispatch, one
+        host sync per horizon (the stacked metric fetch).  Checkpoints and
+        record flushes land on horizon boundaries; the per-round stat
+        surface (record rows, log lines, best-model tracking) is identical
+        to the H=1 loop — metrics just become visible up to H−1 rounds
+        late."""
+        import time as _time
+
+        config = self.config
+        global_params, start_round = self._init_global_params()
+        save_dir = os.path.join(config.save_dir, "server")
+        os.makedirs(save_dir, exist_ok=True)
+        model_dir = os.path.join(config.save_dir, "aggregated_model")
+        os.makedirs(model_dir, exist_ok=True)
+        rng = jax.random.PRNGKey(config.seed)
+        for _ in range(start_round - 1):  # resume: keep the rng stream aligned
+            rng, _unused = jax.random.split(rng)
+        # replicate the chain carry up front: the fused program returns it
+        # replicated, and a sharding mismatch on the first chunk would
+        # retrace the horizon program once per run
+        rng = jax.device_put(rng, self._replicated)
+        self._last_ckpt_round = start_round - 1
+        param_mb = sum(
+            int(np.prod(v.shape)) * 4 for v in jax.tree.leaves(global_params)
+        ) / 1e6
+        cost_factor = self._upload_cost_factor()
+        self._ensure_eval_batches()
+        with self._ckpt:
+            round_number = start_round
+            while round_number <= config.round:
+                # the final chunk may be shorter — a tail program of length
+                # h compiles once and is cached per length
+                h = min(self.round_horizon, config.round - round_number + 1)
+                fn = self._horizon_fns.get(h)
+                if fn is None:
+                    fn = self._horizon_fns[h] = self._build_horizon_fn(h)
+                start = _time.monotonic()
+                boundary = round_number + h - 1
+                host_weights = np.stack(
+                    [
+                        self._select_weights(r)
+                        for r in range(round_number, round_number + h)
+                    ]
+                )
+                weight_rows = put_sharded(
+                    host_weights, self._horizon_weight_sharding
+                )
+                # old params AND the rng carry are donated into the fused
+                # program — pending background fetches must finish first
+                self._ckpt.barrier()
+                (global_params, rng), outs = self._watchdog.call(
+                    lambda gp=global_params, r=rng, w=weight_rows: fn(gp, r, w),
+                    phase="round",
+                    round_number=boundary,
+                )
+                self.dispatch_count += 1
+                # queue the boundary checkpoint NOW: its device→host fetch
+                # overlaps the stacked metric fetch below
+                if self._should_checkpoint(boundary):
+                    self._ckpt.save_npz(
+                        os.path.join(model_dir, f"round_{boundary}.npz"),
+                        self._checkpointable(global_params),
+                    )
+                    self._ckpt_queued_round = boundary
+                    self._last_ckpt_round = boundary
+                # ONE host sync per horizon: the stacked eval metrics
+                per_round = stacked_round_metrics(outs[1])
+                confusion = np.asarray(outs[2]) if len(outs) > 2 else None
+                self.host_sync_count += 1
+                chunk_seconds = _time.monotonic() - start
+                for i in range(h):
+                    r = round_number + i
+                    metric = per_round[i]
+                    if confusion is not None:
+                        metric.update(slow_metrics_from_confusion(confusion[i]))
+                    selected = int((host_weights[i] > 0).sum())
+                    self._note_round(
+                        r,
+                        metric,
+                        save_dir,
+                        extra={
+                            "received_mb": selected * param_mb * cost_factor,
+                            "sent_mb": selected * param_mb,
+                            "round_seconds": chunk_seconds / h,
+                        },
+                    )
+                    self._max_acc = max(self._max_acc, metric["accuracy"])
+                    # only boundary rounds have a checkpoint to promote —
+                    # best_global_model.npz tracks the best CHECKPOINTED
+                    # round under fusion, against its own high-water mark
+                    # (a better mid-horizon round must not starve it)
+                    if (
+                        r == boundary
+                        and self._ckpt_queued_round == boundary
+                        and metric["accuracy"] > self._best_ckpt_acc
+                    ):
+                        self._best_ckpt_acc = metric["accuracy"]
+                        self._ckpt.copy_last_to(
+                            os.path.join(save_dir, "best_global_model.npz")
+                        )
+                self.rounds_run += h
+                round_number += h
+        return {"performance": self._stat}
+
+    def _should_checkpoint(self, round_number: int) -> bool:
+        """Checkpoint cadence: every ``checkpoint_every`` rounds since the
+        last written checkpoint, plus always the run's final round (so the
+        exit state is resumable)."""
+        if round_number >= self.config.round:
+            return True
+        return round_number - self._last_ckpt_round >= self._checkpoint_every
+
+    def reset_dispatch_stats(self) -> None:
+        self.dispatch_count = 0
+        self.host_sync_count = 0
+        self.rounds_run = 0
+
+    @property
+    def dispatches_per_round(self) -> float:
+        return self.dispatch_count / max(1, self.rounds_run)
+
+    @property
+    def host_sync_points(self) -> float:
+        return self.host_sync_count / max(1, self.rounds_run)
+
+    def _ensure_eval_batches(self):
         # test batches are device-resident and built once — rebuilding host
         # arrays per round re-uploads the whole test set every evaluation
         # (~1.3 s/round over the tunneled chip at the canonical scale)
@@ -800,7 +1072,10 @@ class SpmdFedAvgSession:
                 make_epoch_batches(test, self.config.batch_size),
                 self._replicated,
             )
-        summed = self.engine.evaluate(global_params, self._eval_batches)
+        return self._eval_batches
+
+    def _evaluate(self, global_params) -> dict:
+        summed = self.engine.evaluate(global_params, self._ensure_eval_batches())
         metric = summarize_metrics(summed)
         metric.update(
             maybe_slow_metrics(
@@ -809,9 +1084,11 @@ class SpmdFedAvgSession:
         )
         return metric
 
-    def _record(
-        self, round_number, metric, global_params, save_dir, extra=None
-    ) -> None:
+    def _note_round(self, round_number, metric, save_dir, extra=None) -> None:
+        """Record one round's stat row and flush ``round_record.json`` on
+        the ``record_flush_every`` cadence — atomically (tmp file + rename),
+        so a crash never leaves a torn record for resume to trip on.  The
+        final flush rides the checkpoint writer's exit finalizer."""
         round_stat = {f"test_{k}": v for k, v in metric.items()}
         if extra:
             round_stat.update(extra)
@@ -822,11 +1099,28 @@ class SpmdFedAvgSession:
             metric["accuracy"],
             metric["loss"],
         )
-        with open(
-            os.path.join(save_dir, "round_record.json"), "wt", encoding="utf8"
-        ) as f:
-            json.dump(self._stat, f)
-        if self._ckpt_queued_round != round_number:
+        self._record_path = os.path.join(save_dir, "round_record.json")
+        self._record_dirty = True
+        if (
+            round_number % self._record_flush_every == 0
+            or round_number >= self.config.round
+        ):
+            self._flush_record()
+
+    def _flush_record(self) -> None:
+        if not self._record_dirty or self._record_path is None:
+            return
+        atomic_json_dump(self._record_path, self._stat)
+        self._record_dirty = False
+
+    def _record(
+        self, round_number, metric, global_params, save_dir, extra=None
+    ) -> None:
+        self._note_round(round_number, metric, save_dir, extra)
+        if (
+            self._ckpt_queued_round != round_number
+            and self._should_checkpoint(round_number)
+        ):
             # the base run loop queues round_N.npz right after the round
             # program returns (overlapping evaluation); sessions that
             # override run() (OBD, Shapley) queue it here instead.  Async is
@@ -841,14 +1135,24 @@ class SpmdFedAvgSession:
                 os.path.join(model_dir, f"round_{round_number}.npz"),
                 dict(global_params),
             )
+            self._ckpt_queued_round = round_number
+            self._last_ckpt_round = round_number
         # promoting the round checkpoint to best is a file copy chained on
         # the writer queue, not a second device fetch.  If the background
         # save failed, copy_last_to skips the promotion while _max_acc has
         # already advanced — until the fail-fast error surfaces at the next
         # queue operation, best_global_model.npz may lag _max_acc by one
         # round; a crash inside that window leaves the stale best on disk.
-        if metric["accuracy"] > self._max_acc:
-            self._max_acc = metric["accuracy"]
+        self._max_acc = max(self._max_acc, metric["accuracy"])
+        # with a sparse checkpoint cadence, only rounds that wrote
+        # round_N.npz can be promoted — best_global_model.npz tracks the
+        # best CHECKPOINTED round against its own high-water mark, so an
+        # un-checkpointed better round cannot starve later promotions
+        if (
+            self._ckpt_queued_round == round_number
+            and metric["accuracy"] > self._best_ckpt_acc
+        ):
+            self._best_ckpt_acc = metric["accuracy"]
             self._ckpt.copy_last_to(
                 os.path.join(save_dir, "best_global_model.npz")
             )
@@ -890,6 +1194,12 @@ class SpmdSignSGDSession:
         self._watchdog = DeadlineWatchdog.from_config(config, self.mesh)
         self.n_slots = client_slots(config.worker_number, self.mesh)
         self._stat: dict[int, dict] = {}
+        # round-horizon fusion, same contract as SpmdFedAvgSession: scan H
+        # rounds (each already a whole-run-of-steps program) per dispatch,
+        # evaluating in-program, fetching stacked metrics once per horizon
+        self.round_horizon = max(
+            1, int(config.algorithm_kwargs.get("round_horizon", 1) or 1)
+        )
 
         self._data, self._dataset_sizes, self.n_batches = stack_client_data(
             config, dataset_collection, practitioners, self.n_slots
@@ -902,6 +1212,8 @@ class SpmdSignSGDSession:
             {k: np.swapaxes(v, 0, 1) for k, v in self._data.items()},
             NamedSharding(self.mesh, P(None, "clients")),
         )
+        self._run_program_fn = None
+        self._horizon_fns: dict[int, object] = {}
         self._run_fn = self._build_run_fn()
 
     def _build_run_fn(self):
@@ -973,6 +1285,7 @@ class SpmdSignSGDSession:
                 out_specs=(P(), P()),
             )(params, data, weights, rngs)
 
+        self._run_program_fn = run_program
         # data as an argument, not a closure constant (see _build_round_fn)
         jitted = jax.jit(run_program, donate_argnums=(0,))
 
@@ -981,11 +1294,65 @@ class SpmdSignSGDSession:
 
         return fn
 
-    def run(self) -> dict:
+    def _build_horizon_fn(self, horizon: int):
+        """``horizon`` sign-SGD rounds as one jitted, donated scan — the
+        per-round rngs ride as ``[H, n_slots, 2]`` scan inputs (each
+        round's stream is ``PRNGKey(seed + round)``, no carry chain), and
+        each round evaluates in-program on the device-resident test set."""
+        engine = self.engine
+        run_program = self._run_program_fn
+        with_confusion = bool(self.config.use_slow_performance_metrics)
 
+        def horizon_program(params, rng_rows, weights, data, eval_batches):
+            def body(params, rngs):
+                params, epoch_metrics = run_program(params, weights, rngs, data)
+                outs = (epoch_metrics, engine.eval_fn(params, eval_batches))
+                if with_confusion:
+                    outs = outs + (engine.confusion_fn(params, eval_batches),)
+                return params, outs
+
+            return jax.lax.scan(body, params, rng_rows, length=horizon)
+
+        jitted = jax.jit(horizon_program, donate_argnums=(0,))
+
+        def fn(params, rng_rows, weights, eval_batches):
+            return jitted(params, rng_rows, weights, self._data, eval_batches)
+
+        fn._jitted = jitted
+        return fn
+
+    def _note_round(self, round_number: int, metric, epoch_metrics) -> None:
+        """One round's stat row (identical surface on the per-round and
+        horizon-fused paths: test metrics + per-epoch train curves)."""
+        count = np.maximum(np.asarray(epoch_metrics["count"]), 1.0)
+        row = {
+            "test_accuracy": metric["accuracy"],
+            "test_loss": metric["loss"],
+            "test_count": metric["count"],
+            "train_loss_per_epoch": (
+                np.asarray(epoch_metrics["loss_sum"]) / count
+            ).tolist(),
+            "train_accuracy_per_epoch": (
+                np.asarray(epoch_metrics["correct"]) / count
+            ).tolist(),
+        }
+        for key, value in metric.items():  # slow-metric extras
+            if key not in ("accuracy", "loss", "count"):
+                row[f"test_{key}"] = value
+        self._stat[round_number] = row
+        get_logger().info(
+            "round: %d, sign_SGD (spmd) %d steps, test accuracy %.4f loss %.4f",
+            round_number,
+            self.config.epoch * self.n_batches,
+            metric["accuracy"],
+            metric["loss"],
+        )
+
+    def _run_setup(self):
+        """(params, weights, eval batches, server dir) shared by both run
+        loops — put_sharded throughout: multi-host pods need per-process
+        shard placement (see _place_params in SpmdFedAvgSession)."""
         config = self.config
-        # put_sharded throughout: multi-host pods need per-process shard
-        # placement (see _place_params in SpmdFedAvgSession)
         params = put_sharded(
             self.engine.init_params(config.seed), self._replicated
         )
@@ -1001,6 +1368,13 @@ class SpmdSignSGDSession:
         batches = put_sharded(
             make_epoch_batches(test, config.batch_size), self._replicated
         )
+        return params, weights, batches, save_dir
+
+    def run(self) -> dict:
+        if self.round_horizon > 1:
+            return self._run_horizon()
+        config = self.config
+        params, weights, batches, save_dir = self._run_setup()
         best_acc = -1.0
         for round_number in range(1, config.round + 1):
             rngs = put_sharded(
@@ -1024,38 +1398,79 @@ class SpmdSignSGDSession:
             metric = self._watchdog.call(
                 guarded_eval, phase="eval", round_number=round_number
             )
-            count = np.maximum(np.asarray(epoch_metrics["count"]), 1.0)
-            self._stat[round_number] = {
-                "test_accuracy": metric["accuracy"],
-                "test_loss": metric["loss"],
-                "test_count": metric["count"],
-                "train_loss_per_epoch": (
-                    np.asarray(epoch_metrics["loss_sum"]) / count
-                ).tolist(),
-                "train_accuracy_per_epoch": (
-                    np.asarray(epoch_metrics["correct"]) / count
-                ).tolist(),
-            }
-            for key, value in metric.items():  # slow-metric extras
-                if key not in ("accuracy", "loss", "count"):
-                    self._stat[round_number][f"test_{key}"] = value
-            get_logger().info(
-                "round: %d, sign_SGD (spmd) %d steps, test accuracy %.4f loss %.4f",
-                round_number,
-                config.epoch * self.n_batches,
-                metric["accuracy"],
-                metric["loss"],
+            self._note_round(round_number, metric, epoch_metrics)
+            atomic_json_dump(
+                os.path.join(save_dir, "round_record.json"), self._stat
             )
-            with open(
-                os.path.join(save_dir, "round_record.json"), "wt", encoding="utf8"
-            ) as f:
-                json.dump(self._stat, f)
             if metric["accuracy"] > best_acc:
                 best_acc = metric["accuracy"]
                 np.savez(
                     os.path.join(save_dir, "best_global_model.npz"),
                     **{k: np.asarray(v) for k, v in params.items()},
                 )
+        return {"performance": self._stat}
+
+    def _run_horizon(self) -> dict:
+        """The fused run loop: H sign-SGD rounds per dispatch with
+        in-program evaluation; the record lands once per horizon (atomic),
+        and best_global_model.npz tracks the best HORIZON-BOUNDARY round
+        (only boundary params are ever materialized on host)."""
+        config = self.config
+        params, weights, batches, save_dir = self._run_setup()
+        rng_sharding = NamedSharding(self.mesh, P(None, "clients"))
+        record_path = os.path.join(save_dir, "round_record.json")
+        # best-boundary high-water mark, independent of mid-horizon rounds
+        # (only boundary params materialize, so only they can be saved —
+        # a better in-horizon round must not starve later saves)
+        best_saved_acc = -1.0
+        round_number = 1
+        while round_number <= config.round:
+            h = min(self.round_horizon, config.round - round_number + 1)
+            fn = self._horizon_fns.get(h)
+            if fn is None:
+                fn = self._horizon_fns[h] = self._build_horizon_fn(h)
+            boundary = round_number + h - 1
+            # same per-round streams as H=1: PRNGKey(seed + round), split
+            # to slots — stacked into [H, n_slots, 2] scan rows
+            rng_rows = put_sharded(
+                np.stack(
+                    [
+                        np.asarray(
+                            jax.random.split(
+                                jax.random.PRNGKey(config.seed + r),
+                                self.n_slots,
+                            )
+                        )
+                        for r in range(round_number, round_number + h)
+                    ]
+                ),
+                rng_sharding,
+            )
+            params, outs = self._watchdog.call(
+                lambda p=params, rr=rng_rows: fn(p, rr, weights, batches),
+                phase="round",
+                round_number=boundary,
+            )
+            epoch_metrics = jax.tree.map(np.asarray, outs[0])  # [h, epochs]
+            per_round = stacked_round_metrics(outs[1])
+            confusion = np.asarray(outs[2]) if len(outs) > 2 else None
+            for i in range(h):
+                metric = per_round[i]
+                if confusion is not None:
+                    metric.update(slow_metrics_from_confusion(confusion[i]))
+                self._note_round(
+                    round_number + i,
+                    metric,
+                    {k: v[i] for k, v in epoch_metrics.items()},
+                )
+            atomic_json_dump(record_path, self._stat)
+            if per_round[-1]["accuracy"] > best_saved_acc:
+                best_saved_acc = per_round[-1]["accuracy"]
+                np.savez(
+                    os.path.join(save_dir, "best_global_model.npz"),
+                    **{k: np.asarray(v) for k, v in params.items()},
+                )
+            round_number += h
         return {"performance": self._stat}
 
     @property

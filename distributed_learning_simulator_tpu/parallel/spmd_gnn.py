@@ -434,7 +434,13 @@ class SpmdFedGNNSession:
         save_dir = os.path.join(config.save_dir, "server")
         os.makedirs(save_dir, exist_ok=True)
         init_params, start_round = self._init_global_params()
-        global_params = put_sharded(init_params, self._replicated)
+        # jnp.copy after placement: device_put of aligned host numpy (the
+        # npz resume path) ALIASES the python-owned buffer, and the round
+        # program donates these params — XLA must own the memory it reuses
+        # (see SpmdFedAvgSession._place_params)
+        global_params = jax.tree.map(
+            jnp.copy, put_sharded(init_params, self._replicated)
+        )
         weights = put_sharded(
             self._dataset_sizes, self._client_sharding
         )
@@ -495,14 +501,13 @@ class SpmdFedGNNSession:
                     metric["loss"],
                     mb,
                 )
-                import json
+                from ..util.checkpoint import atomic_json_dump
 
-                with open(
-                    os.path.join(save_dir, "round_record.json"),
-                    "wt",
-                    encoding="utf8",
-                ) as f:
-                    json.dump(self._stat, f)
+                # atomic: a crash mid-write must not leave a torn record
+                # for load_resume_state to trip on
+                atomic_json_dump(
+                    os.path.join(save_dir, "round_record.json"), self._stat
+                )
                 if metric["accuracy"] > self._max_acc:
                     self._max_acc = metric["accuracy"]
                     # file copy of the queued round checkpoint, no 2nd fetch

@@ -448,7 +448,13 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         os.makedirs(save_dir, exist_ok=True)
         driver = ObdRoundDriver.from_config(config)
         init_params, resumed_aggs, resumed_phase1 = self._try_resume_obd(driver)
-        train_params = put_sharded(init_params, self._replicated)
+        # jnp.copy after placement: device_put of aligned host numpy (the
+        # npz resume path) ALIASES the python-owned buffer, and the round
+        # program donates these params — XLA must own the memory it reuses
+        # (see SpmdFedAvgSession._place_params)
+        train_params = jax.tree.map(
+            jnp.copy, put_sharded(init_params, self._replicated)
+        )
         rng = jax.random.PRNGKey(config.seed)
         for _ in range(resumed_aggs):  # keep the rng stream aligned
             rng, _r, _b = jax.random.split(rng, 3)
@@ -456,6 +462,12 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         # per-slot optimizer states, carried round-to-round (restored from
         # opt_state.npz when the resume landed on the matching aggregate)
         opt_state_s = getattr(self, "_resumed_opt_state", None)
+        if opt_state_s is not None:
+            # same aliasing hazard as train_params: phase 2 DONATES these
+            # states, so the restored numpy leaves need XLA-owned buffers
+            opt_state_s = jax.tree.map(
+                jnp.copy, put_sharded(opt_state_s, self._client_sharding)
+            )
 
         def step(fn, params, weights, round_number, phase_label, use_opt):
             nonlocal rng, opt_state_s

@@ -168,8 +168,14 @@ class SpmdSMAFDSession(SpmdFedAvgSession):
                         ):
                             restored = loaded
             if restored is not None:
-                self._err_state = put_sharded(
-                    restored, NamedSharding(self.mesh, P("clients"))
+                # jnp.copy: the residuals are DONATED into the round
+                # program, and device_put of host numpy can alias the
+                # python-owned buffer (see SpmdFedAvgSession._place_params)
+                self._err_state = jax.tree.map(
+                    jnp.copy,
+                    put_sharded(
+                        restored, NamedSharding(self.mesh, P("clients"))
+                    ),
                 )
                 get_logger().info(
                     "smafd resume: restored error-feedback residuals "
@@ -195,8 +201,11 @@ class SpmdSMAFDSession(SpmdFedAvgSession):
             lambda p: np.zeros((self.n_slots, *p.shape), np.float32),
             self.engine.init_params(self.config.seed),
         )
-        self._err_state = put_sharded(
-            err0, NamedSharding(self.mesh, P("clients"))
+        # jnp.copy: donated state must live in XLA-owned buffers, not
+        # (possibly aliased) host numpy memory — see _place_params
+        self._err_state = jax.tree.map(
+            jnp.copy,
+            put_sharded(err0, NamedSharding(self.mesh, P("clients"))),
         )
 
     def _build_round_fn(self):

@@ -155,7 +155,17 @@ class AggregationServer(Server):
         )
         self._model_cache.cache_parameter_dict(result.parameter, model_path)
         if self.config.checkpoint_every_round:
-            self._model_cache.save()
+            # config.checkpoint_every thins the cadence (0/1 = legacy
+            # every-round); the final round and an end_training aggregate
+            # always land so the exit state stays resumable
+            every = max(1, int(getattr(self.config, "checkpoint_every", 0) or 1))
+            if (
+                every == 1
+                or recorded_key % every == 0
+                or recorded_key >= self.config.round
+                or result.end_training
+            ):
+                self._model_cache.save()
 
     def _after_send_result(self, result: Message) -> None:
         if isinstance(result, ParameterMessageBase) and not result.in_round:

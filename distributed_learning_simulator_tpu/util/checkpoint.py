@@ -19,9 +19,20 @@ re-raised promptly at the next queue operation (fail-fast, first error
 wins) and again by ``wait()`` / the ``with`` block at run end.
 """
 
+import json
 import os
 import queue
 import threading
+
+
+def atomic_json_dump(path: str, obj) -> None:
+    """Write ``obj`` as JSON via a tmp file + rename: a reader (or a crash
+    mid-write) never sees a torn file — the contract round_record.json
+    needs now that it is the resume source of record rows."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "wt", encoding="utf8") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
 
 
 class AsyncCheckpointWriter:
@@ -41,6 +52,17 @@ class AsyncCheckpointWriter:
         self._error: BaseException | None = None
         self._last_path: str | None = None
         self._last_save_ok: list[bool] = [True]
+        self._finalizers: dict[str, object] = {}
+
+    def register_finalizer(self, name: str, fn) -> None:
+        """Register a callable to run when the writer's ``with`` block
+        exits (before the queue drains) — the hook run loops use to flush
+        host-side state they only write on a cadence (e.g. the
+        ``round_record.json`` rows batched by ``record_flush_every``).
+        Re-registering a name replaces the previous callable; finalizers
+        run on the error path too (a failing one is logged, not raised,
+        while another error is unwinding)."""
+        self._finalizers[name] = fn
 
     def _worker(self) -> None:
         while True:
@@ -139,9 +161,31 @@ class AsyncCheckpointWriter:
         return self
 
     def __exit__(self, *exc_info) -> None:
+        # run EVERY finalizer, then drain the queue, and only then surface
+        # a finalizer failure — raising early would abandon queued npz
+        # writes in the daemon worker (breaking the final-round resume
+        # contract) and skip the remaining finalizers
+        finalizer_error: BaseException | None = None
+        for name, fn in list(self._finalizers.items()):
+            try:
+                fn()
+            except BaseException as final_err:  # noqa: BLE001
+                if exc_info[0] is None and finalizer_error is None:
+                    finalizer_error = final_err
+                else:
+                    from ..utils.logging import get_logger
+
+                    get_logger().warning(
+                        "finalizer %s failed during error unwind "
+                        "(suppressed): %s",
+                        name,
+                        final_err,
+                    )
         # on clean exit surface background errors; on exception just drain
         if exc_info[0] is None:
             self.wait()
+            if finalizer_error is not None:
+                raise finalizer_error
         else:
             try:
                 self.wait()

@@ -9,6 +9,15 @@ entry (and the threaded path records before it caches) — a crash in that
 window leaves one side orphaned.  Resuming only from rounds that have both
 keeps stats/best-model bookkeeping complete; the orphan is simply
 re-trained.
+
+Horizon-fused runs (``algorithm_kwargs.round_horizon`` /
+``config.checkpoint_every``) checkpoint AND flush record rows on the same
+horizon boundaries, so the latest both-sides round is always a boundary —
+a resumed session (any horizon, including H=1) starts at ``last + 1`` and
+re-aligns the rng chain by replaying ``last`` splits, which is exactly the
+state the fused program's in-program chain would have reached.  Resuming
+with a DIFFERENT horizon is safe: the chain depends only on the round
+index, not on how rounds were chunked into dispatches.
 """
 
 import json

@@ -31,6 +31,23 @@ def test_bench_main_prints_one_json_line(monkeypatch):
             "per_tensor_s_per_round": 0.05,
         },
     )
+    monkeypatch.setattr(
+        bench,
+        "measure_round_horizon",
+        lambda: {
+            "h1": {
+                "rounds_per_sec": 1.0,
+                "dispatches_per_round": 3.0,
+                "host_sync_points": 1.0,
+            },
+            f"h{bench.HZ_HORIZON}": {
+                "rounds_per_sec": 1.5,
+                "dispatches_per_round": 1.0 / bench.HZ_HORIZON,
+                "host_sync_points": 1.0 / bench.HZ_HORIZON,
+            },
+            "speedup": 1.5,
+        },
+    )
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     bench.main()
@@ -49,12 +66,20 @@ def test_bench_main_prints_one_json_line(monkeypatch):
         "agg_path",
         "aggregation",
         "headline_explained",
+        "dispatches_per_round",
+        "host_sync_points",
+        "dispatch_budget",
     ):
         assert field in payload, field
     assert payload["metric"] == "fedavg_cifar10_100clients_rounds_per_sec"
     assert payload["agg_path"] in ("flat", "per_tensor")
     # aggregation wall time is reported per round, separately per path
     assert "flat_s_per_round" in payload["aggregation"]
+    # the headline dispatch-budget pair comes from the FUSED run: one
+    # dispatch and one host sync per horizon
+    assert payload["dispatches_per_round"] == 1.0 / bench.HZ_HORIZON
+    assert payload["host_sync_points"] == 1.0 / bench.HZ_HORIZON
+    assert "h1" in payload["dispatch_budget"]
 
 
 def test_bench_main_survives_measurement_failures(monkeypatch):
@@ -71,6 +96,7 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     monkeypatch.setattr(bench, "measure_long_context", boom)
     monkeypatch.setattr(bench, "measure_large_scale", boom)
     monkeypatch.setattr(bench, "measure_aggregation", boom)
+    monkeypatch.setattr(bench, "measure_round_horizon", boom)
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     bench.main()
@@ -82,3 +108,7 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     # agg_path still records the default path even when timing it failed
     assert payload["agg_path"] == "flat"
     assert "error" in payload["aggregation"]
+    assert "error" in payload["dispatch_budget"]
+    # the headline pair degrades to 0.0, never a missing field
+    assert payload["dispatches_per_round"] == 0.0
+    assert payload["host_sync_points"] == 0.0

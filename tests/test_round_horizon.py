@@ -1,0 +1,228 @@
+"""Round-horizon fusion (``algorithm_kwargs.round_horizon``): H rounds per
+jitted dispatch with in-program evaluation must be a pure SCHEDULING change
+— bit-identical trajectories (params AND metrics) vs the per-round loop,
+one dispatch + one host sync per horizon, checkpoints/resume landing on
+horizon boundaries and re-joining the H=1 rng chain."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from conftest import fed_avg_config
+from distributed_learning_simulator_tpu.training import _build_task, train
+
+
+def _config(rounds, horizon=1, **overrides):
+    algorithm_kwargs = dict(overrides.pop("algorithm_kwargs", {}))
+    if horizon != 1:
+        algorithm_kwargs["round_horizon"] = horizon
+    config = fed_avg_config(
+        executor="spmd",
+        worker_number=2,
+        round=rounds,
+        batch_size=32,
+        epoch=1,
+        dataset_kwargs={"train_size": 64, "val_size": 16, "test_size": 32},
+        algorithm_kwargs=algorithm_kwargs,
+        **overrides,
+    )
+    config.load_config_and_process()
+    return config
+
+
+def _final_params(save_dir, round_number):
+    with np.load(
+        os.path.join(save_dir, "aggregated_model", f"round_{round_number}.npz")
+    ) as blob:
+        return {k: blob[k] for k in blob.files}
+
+
+def test_h1_vs_h8_trajectory_parity(tmp_session_dir):
+    """The acceptance pin: H=8 fuses 8 rounds into one dispatch and must
+    reproduce the H=1 per-round trajectory BIT-EXACTLY — every round's
+    test metrics and the final aggregated params."""
+    r1 = train(_config(rounds=8, save_dir="h1"))
+    r8 = train(_config(rounds=8, horizon=8, save_dir="h8"))
+    assert set(r1["performance"]) == set(r8["performance"]) == set(range(1, 9))
+    for rn in range(1, 9):
+        a, b = r1["performance"][rn], r8["performance"][rn]
+        assert a["test_accuracy"] == b["test_accuracy"], (rn, a, b)
+        assert a["test_loss"] == b["test_loss"], (rn, a, b)
+        assert a["test_count"] == b["test_count"], rn
+    p1 = _final_params("h1", 8)
+    p8 = _final_params("h8", 8)
+    assert p1.keys() == p8.keys()
+    for key in p1:
+        np.testing.assert_array_equal(p1[key], p8[key])
+    # checkpoint cadence follows the horizon: only the boundary landed
+    assert sorted(os.listdir(os.path.join("h8", "aggregated_model"))) == [
+        "round_8.npz"
+    ]
+
+
+def test_one_dispatch_per_horizon_no_retrace(tmp_session_dir):
+    """8 rounds at H=4 = exactly 2 dispatches and 2 host syncs, through ONE
+    compiled horizon program (no retrace across chunks — the no-retrace
+    guard pattern from test_flat_aggregation)."""
+    config = _config(rounds=8, horizon=4, save_dir="hd")
+    ctx = _build_task(config)
+    from distributed_learning_simulator_tpu.parallel.spmd import (
+        SpmdFedAvgSession,
+    )
+
+    session = SpmdFedAvgSession(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+    session.run()
+    assert session.rounds_run == 8
+    assert session.dispatch_count == 2
+    assert session.host_sync_count == 2
+    assert session.dispatches_per_round <= 1 / 4 + 1e-9
+    assert session.host_sync_points <= 1 / 4 + 1e-9
+    # both chunks are full horizons -> one cached program, compiled once
+    assert list(session._horizon_fns) == [4]
+    assert session._horizon_fns[4]._jitted._cache_size() == 1
+
+
+def test_resume_from_horizon_boundary_rejoins_h1_chain(tmp_session_dir):
+    """A fused run checkpoints on horizon boundaries; resuming from one
+    (with H=1 here) must re-align the rng chain and continue the exact
+    trajectory a pure H=1 run would have produced."""
+    reference = train(_config(rounds=6, save_dir="ref"))
+    train(_config(rounds=4, horizon=2, save_dir="fused"))
+    # the fused run's checkpoints are exactly the horizon boundaries
+    assert sorted(os.listdir(os.path.join("fused", "aggregated_model"))) == [
+        "round_2.npz",
+        "round_4.npz",
+    ]
+    resumed = train(
+        _config(
+            rounds=6,
+            save_dir="res",
+            algorithm_kwargs={"resume_dir": "fused"},
+        )
+    )
+    assert set(resumed["performance"]) == set(range(1, 7))
+    # rounds 1-4 restored verbatim from the fused run's record
+    for rn in range(1, 5):
+        assert (
+            resumed["performance"][rn]["test_accuracy"]
+            == reference["performance"][rn]["test_accuracy"]
+        ), rn
+    # rounds 5-6 trained fresh on the re-joined chain: bit-equal to the
+    # never-interrupted H=1 reference
+    for rn in (5, 6):
+        a, b = reference["performance"][rn], resumed["performance"][rn]
+        assert a["test_accuracy"] == b["test_accuracy"], (rn, a, b)
+        assert a["test_loss"] == b["test_loss"], (rn, a, b)
+    pa = _final_params("ref", 6)
+    pb = _final_params("res", 6)
+    for key in pa:
+        np.testing.assert_array_equal(pa[key], pb[key])
+
+
+def test_fold_chain_stays_device_resident_and_bit_identical(tmp_session_dir):
+    """The per-round client rng chain is computed by a jitted fold (no
+    device→host→device bounce) and must be bit-identical to the host
+    formula the threaded executor replays (aligned_round_stream)."""
+    config = _config(rounds=1, save_dir="fold")
+    ctx = _build_task(config)
+    from distributed_learning_simulator_tpu.parallel.spmd import (
+        SpmdFedAvgSession,
+    )
+
+    session = SpmdFedAvgSession(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+    _, round_rng = jax.random.split(jax.random.PRNGKey(config.seed))
+    folded = session._fold_rngs(round_rng)
+    assert isinstance(folded, jax.Array)
+    expected = np.asarray(
+        jax.vmap(lambda i: jax.random.fold_in(round_rng, i))(
+            jnp.arange(session.n_slots)
+        )
+    )
+    np.testing.assert_array_equal(np.asarray(folded), expected)
+    # and per worker id, the threaded executor's helper sees the same key
+    from distributed_learning_simulator_tpu.engine.executor import (
+        aligned_round_stream,
+    )
+
+    for worker_id in range(config.worker_number):
+        np.testing.assert_array_equal(
+            np.asarray(folded)[worker_id],
+            np.asarray(aligned_round_stream(config.seed, 1, worker_id)),
+        )
+
+
+def test_sign_sgd_horizon_parity(tmp_session_dir):
+    """SpmdSignSGDSession fuses rounds the same way: stacked per-epoch
+    train curves and in-program eval metrics match the per-round loop."""
+    r1 = train(
+        _config(rounds=3, save_dir="s1", distributed_algorithm="sign_SGD")
+    )
+    r3 = train(
+        _config(
+            rounds=3,
+            horizon=3,
+            save_dir="s3",
+            distributed_algorithm="sign_SGD",
+        )
+    )
+    assert set(r1["performance"]) == set(r3["performance"]) == {1, 2, 3}
+    for rn in (1, 2, 3):
+        a, b = r1["performance"][rn], r3["performance"][rn]
+        assert a["test_accuracy"] == b["test_accuracy"], (rn, a, b)
+        assert a["test_loss"] == b["test_loss"], (rn, a, b)
+        assert a["train_loss_per_epoch"] == b["train_loss_per_epoch"], rn
+        assert a["train_accuracy_per_epoch"] == b["train_accuracy_per_epoch"], rn
+
+
+def test_record_flush_cadence_and_atomicity(tmp_session_dir):
+    """Under fusion the record flushes once per horizon (atomic rename —
+    no torn files), and the exit finalizer leaves the complete record."""
+    import json
+
+    train(_config(rounds=4, horizon=2, save_dir="rec"))
+    record_path = os.path.join("rec", "server", "round_record.json")
+    assert os.path.isfile(record_path)
+    assert not os.path.exists(record_path + ".tmp")
+    with open(record_path, encoding="utf8") as f:
+        rows = json.load(f)
+    assert sorted(int(k) for k in rows) == [1, 2, 3, 4]
+    for row in rows.values():
+        assert "test_accuracy" in row and "round_seconds" in row
+
+
+def test_unsupported_session_rejects_round_horizon(tmp_session_dir):
+    """Sessions with their own round programs (OBD here) must refuse the
+    knob loudly instead of silently ignoring it."""
+    import pytest
+
+    config = _config(
+        rounds=2,
+        horizon=2,
+        save_dir="obd",
+        distributed_algorithm="fed_obd",
+        algorithm_kwargs={
+            "round_horizon": 2,
+            "dropout_rate": 0.3,
+            "second_phase_epoch": 1,
+        },
+        endpoint_kwargs={
+            "server": {"weight": 0.01},
+            "worker": {"weight": 0.01},
+        },
+    )
+    with pytest.raises(ValueError, match="round_horizon"):
+        train(config)
