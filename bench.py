@@ -118,11 +118,19 @@ def make_vit_config():
     )
 
 
-def _measure_session(config, memory_out: dict | None = None) -> tuple[float, float]:
+def _measure_session(
+    config,
+    memory_out: dict | None = None,
+    stats_out: dict | None = None,
+) -> tuple[float, float]:
     """(rounds/sec, mfu) of one SPMD whole-round program (after compile
     warmup), bf16 compute, hard host-fetch syncs.  ``memory_out`` (when
     given) receives the compiled program's static memory analysis — the
-    peak-HBM evidence the tunneled platform's runtime stats can't give."""
+    peak-HBM evidence the tunneled platform's runtime stats can't give.
+    ``stats_out`` receives the session's selection-path facts
+    (selection_path, s_pad, wasted_compute_fraction).  Round inputs come
+    from the session's own ``_prepare_round_inputs`` so partial-
+    participation configs exercise their actual (gather or dense) path."""
     import jax
     import numpy as np
 
@@ -136,22 +144,34 @@ def _measure_session(config, memory_out: dict | None = None) -> tuple[float, flo
     global_params = jax.device_put(
         ctx.engine.init_params(config.seed), session._replicated
     )
-    weights = jax.device_put(session._select_weights(1), session._client_sharding)
-    rngs = jax.device_put(
-        jax.random.split(jax.random.PRNGKey(0), session.n_slots),
-        session._client_sharding,
+    _, weights, rngs, sel_idx = session._prepare_round_inputs(
+        1, jax.random.PRNGKey(0)
     )
+    if stats_out is not None:
+        stats_out["selection_path"] = (
+            "gather" if session._selection_gather else "dense"
+        )
+        stats_out["s_pad"] = session.s_pad
+        stats_out["wasted_compute_fraction"] = round(
+            session.wasted_compute_fraction, 4
+        )
     flops_per_round = session.round_flops(global_params)
+
+    def run_round(gp):
+        if sel_idx is not None:
+            return session._round_fn(gp, weights, rngs, sel_idx)
+        return session._round_fn(gp, weights, rngs)
+
     # warmup/compile; sync via host fetch, not just block_until_ready: on
     # the tunneled axon platform a runtime failure can pass
     # block_until_ready silently and only surface (or block) at transfer
     # time — fetching a scalar derived from the whole round both hard-syncs
     # and validates the execution
-    global_params, metrics = session._round_fn(global_params, weights, rngs)
+    global_params, metrics = run_round(global_params)
     float(np.asarray(jax.tree.leaves(metrics)[0]))
     start = time.monotonic()
     for _ in range(ROUNDS_MEASURED):
-        global_params, metrics = session._round_fn(global_params, weights, rngs)
+        global_params, metrics = run_round(global_params)
     float(np.asarray(jax.tree.leaves(metrics)[0]))
     elapsed = time.monotonic() - start
     rounds_per_sec = ROUNDS_MEASURED / elapsed
@@ -159,14 +179,17 @@ def _measure_session(config, memory_out: dict | None = None) -> tuple[float, flo
     mfu = (flops_per_round * rounds_per_sec / peak) if peak else 0.0
     if memory_out is not None:
         try:
-            mem = (
-                session._jitted_round_fn.lower(
+            if sel_idx is not None:
+                lowered = session._jitted_gather_round_fn.lower(
+                    global_params, weights, rngs, sel_idx, session._data,
+                    session._val_data or {},
+                )
+            else:
+                lowered = session._jitted_round_fn.lower(
                     global_params, weights, rngs, session._data,
                     session._val_data or {},
                 )
-                .compile()
-                .memory_analysis()
-            )
+            mem = lowered.compile().memory_analysis()
             memory_out["program_hbm_gb"] = {
                 "arguments": round(mem.argument_size_in_bytes / 2**30, 3),
                 "outputs": round(mem.output_size_in_bytes / 2**30, 3),
@@ -215,7 +238,10 @@ def measure_large_scale() -> dict:
         },
     )
     memory: dict = {}
-    rounds_per_sec, mfu = _measure_session(config, memory_out=memory)
+    stats: dict = {}
+    rounds_per_sec, mfu = _measure_session(
+        config, memory_out=memory, stats_out=stats
+    )
     entry = {
         "metric": "fedavg_agnews_bert_small_1000clients_rounds_per_sec",
         "value": round(rounds_per_sec, 4),
@@ -225,6 +251,7 @@ def measure_large_scale() -> dict:
         "client_chunk": LS_CHUNK,
         "mfu": round(mfu, 4),
         "dtype": "bf16",
+        **stats,
         **memory,
     }
     try:
@@ -302,6 +329,57 @@ def measure_round_horizon() -> dict:
     h1, hH = out["h1"], out[f"h{HZ_HORIZON}"]
     if h1["rounds_per_sec"]:
         out["speedup"] = round(hH["rounds_per_sec"] / h1["rounds_per_sec"], 3)
+    return out
+
+
+# selection-aware gather A/B (the 1000-client / 100-selected LeNet shape):
+# the dense program trains all 1000 slots and zero-masks 900 of them at
+# aggregation; the gather path trains only the s_pad≈100 selected slots.
+# Reports rounds/sec per path, the speedup, and each path's
+# wasted_compute_fraction — the fraction of trained-slot compute whose
+# aggregation weight is zero.  One batch of 8 per client and a bounded
+# client_chunk keep the DENSE arm benchable on slow hosts (the A/B's
+# signal is the slot-count ratio, not per-slot wall time).
+SEL_WORKERS = 1000
+SEL_SELECTED = 100
+SEL_BATCH = 8
+SEL_CHUNK = 50
+
+
+def measure_selection_gather() -> dict:
+    out: dict = {
+        "model": "LeNet5/MNIST",
+        "workers": SEL_WORKERS,
+        "selected_per_round": SEL_SELECTED,
+    }
+    for path in ("gather", "dense"):
+        config = make_config(
+            "spmd",
+            SEL_WORKERS,
+            SEL_WORKERS * SEL_BATCH,
+            model_name="LeNet5",
+            batch_size=SEL_BATCH,
+            tag=f"sel_{path}",
+            dataset_name="MNIST",
+            use_amp=False,  # the canonical LeNet5/MNIST config is fp32
+            algorithm_kwargs={
+                "random_client_number": SEL_SELECTED,
+                "selection_gather": path == "gather",
+                "client_chunk": SEL_CHUNK,
+            },
+        )
+        stats: dict = {}
+        rounds_per_sec, mfu = _measure_session(config, stats_out=stats)
+        out[path] = {
+            "rounds_per_sec": round(rounds_per_sec, 4),
+            "mfu": round(mfu, 4),
+            **stats,
+        }
+    if out["dense"]["rounds_per_sec"]:
+        out["speedup"] = round(
+            out["gather"]["rounds_per_sec"] / out["dense"]["rounds_per_sec"], 3
+        )
+    out["wasted_compute_fraction"] = out["gather"]["wasted_compute_fraction"]
     return out
 
 
@@ -612,6 +690,12 @@ def main() -> None:
         large_scale = measure_large_scale()
     except Exception as exc:
         large_scale = {"error": str(exc)[:200]}
+    # selection-aware gather A/B at the 1000-client/100-selected LeNet
+    # shape: O(selected) vs O(population) round compute
+    try:
+        selection = measure_selection_gather()
+    except Exception as exc:
+        selection = {"selection_path": "gather", "error": str(exc)[:200]}
     # server aggregation wall time per round, flat (ParamVec) vs per-tensor
     # — the threaded server hot path the whole-round programs fold away
     try:
@@ -664,6 +748,15 @@ def main() -> None:
                 },
                 "long_context": lc,
                 "large_scale": large_scale,
+                # selection-aware gather: which round path partial-
+                # participation configs take by default, the dense-vs-
+                # gather A/B, and the default path's wasted compute
+                "selection_path": selection.get("selection_path")
+                or selection.get("gather", {}).get("selection_path", "gather"),
+                "wasted_compute_fraction": selection.get(
+                    "wasted_compute_fraction", 0.0
+                ),
+                "selection": selection,
                 # which server aggregation path production configs take
                 # ("flat" ParamVec pipeline vs the legacy "per_tensor"
                 # walk) + its isolated wall time per round

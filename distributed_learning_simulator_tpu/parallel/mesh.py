@@ -36,9 +36,17 @@ def initialize_multihost(
     backend is single-host pipes — SURVEY.md §5): collectives ride ICI/DCN
     via the mesh, not a side channel.  Idempotent."""
     # NOT jax.process_count(): that would touch the backend, and
-    # jax.distributed.initialize() must run before backend init
-    if jax.distributed.is_initialized():
-        return  # already joined
+    # jax.distributed.initialize() must run before backend init.
+    # ``is_initialized`` does not exist on every jax version — fall back to
+    # probing the distributed global state's client handle.
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        if is_init():
+            return  # already joined
+    else:
+        state = getattr(jax.distributed, "global_state", None)
+        if state is not None and getattr(state, "client", None) is not None:
+            return  # already joined
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -376,6 +376,61 @@ class SpmdFedAvgSession:
         self.client_chunk = client_chunk or int(
             config.algorithm_kwargs.get("client_chunk", 0)
         )
+        # ---- selection-aware gather: O(selected) round compute ----
+        # Under partial participation the dense round program trains every
+        # one of the ``n_slots`` client slots and zero-masks the unselected
+        # ones at aggregation — at 1000 clients / 100 selected ~90% of the
+        # device FLOPs multiply into zero.  Host-side we compute the round's
+        # selected worker ids (deterministic, ``utils/selection.py``), pad
+        # them to a FIXED ``s_pad`` (static shapes — no retraces; divisible
+        # by the slot axes so ``shard_map`` stays balanced), and the jitted
+        # round program gathers the selected slots' data/val/weights/rngs
+        # along the slot axis (``jnp.take`` + sharding constraint) BEFORE
+        # entering ``shard_map``, so the client-chunk scan runs over
+        # ``s_pad`` slots instead of ``n_slots``.  The full client stack
+        # stays device-resident — selection is a device-side gather, no
+        # per-round host restaging.  Trajectories are bit-identical to the
+        # dense path: per-client rng streams are fold_in-indexed by WORKER
+        # ID (the gather carries the ids), and unselected slots contributed
+        # exact zeros.  ``algorithm_kwargs.selection_gather: false`` is the
+        # escape hatch; FSDP and full participation fall back loudly.
+        k = config.algorithm_kwargs.get("random_client_number")
+        self._selected_per_round = min(
+            int(k) if k is not None else config.worker_number,
+            config.worker_number,
+        )
+        selection_active = k is not None and int(k) < config.worker_number
+        sg_requested = config.algorithm_kwargs.get("selection_gather")
+        self._selection_gather = bool(
+            selection_active
+            and type(self) is SpmdFedAvgSession
+            and not self._fsdp
+            and sg_requested is not False
+        )
+        if sg_requested and not self._selection_gather:
+            if not selection_active:
+                reason = (
+                    "full participation (no random_client_number below"
+                    " worker_number) — nothing to skip"
+                )
+            elif type(self) is not SpmdFedAvgSession:
+                reason = f"{type(self).__name__} builds its own round program"
+            else:
+                reason = (
+                    "FSDP model sharding stores params in the dense slot"
+                    " layout (all-gather/reduce_scatter are population-"
+                    "shaped)"
+                )
+            get_logger().warning(
+                "selection_gather requested but unsupported: %s — falling"
+                " back to the dense O(population) round path",
+                reason,
+            )
+        self.s_pad = (
+            client_slots(self._selected_per_round, self.mesh, slot_axes)
+            if self._selection_gather
+            else self.n_slots
+        )
         # round-horizon fusion (``algorithm_kwargs.round_horizon``): fuse H
         # consecutive rounds into ONE jitted, donated ``lax.scan`` over
         # rounds, with per-round test evaluation in-program — the host
@@ -487,6 +542,16 @@ class SpmdFedAvgSession:
             )(slot_indices),
             out_shardings=self._client_sharding,
         )
+        # gather-path twin: fold the SAME per-worker streams, but only for
+        # the round's selected ids — ``fold_in`` is indexed by worker id
+        # alone, so gathering the folded keys by id keeps the stream
+        # bit-identical to the dense path's
+        self._fold_sel_rngs = jax.jit(
+            lambda round_rng, sel_idx: jax.vmap(
+                lambda i: jax.random.fold_in(round_rng, i)
+            )(sel_idx),
+            out_shardings=self._client_sharding,
+        )
         # horizon-fused weight rows: [H, n_slots] with rounds replicated
         # and slots sharded like every other slot-stacked input
         self._horizon_weight_sharding = NamedSharding(
@@ -498,6 +563,9 @@ class SpmdFedAvgSession:
         #: Subclasses with their own round functions leave it None and
         #: cannot fuse rounds.
         self._round_program_fn = None
+        #: gather-path twins (selection-aware sessions only)
+        self._gather_program_fn = None
+        self._jitted_gather_round_fn = None
         self._horizon_fns: dict[int, object] = {}
         self._round_fn = self._build_round_fn()
         if self.round_horizon > 1 and self._round_program_fn is None:
@@ -713,7 +781,46 @@ class SpmdFedAvgSession:
         # tunneled axon platform returns no runtime memory_stats)
         self._jitted_round_fn = jitted
 
-        def fn(global_params, weights, rngs):
+        if self._selection_gather:
+            client_sharding = self._client_sharding
+
+            def gather_round_program(
+                global_params, weights, rngs, sel_idx, data, val
+            ):
+                """The SAME round program over a gathered ``[s_pad]`` slot
+                stack: a device-side ``jnp.take`` along the slot axis (the
+                full ``[C, ...]`` client stack stays resident — no host
+                restaging), then the identical ``shard_map`` body over
+                ``s_pad`` slots instead of ``n_slots``."""
+
+                def take(x):
+                    return jax.lax.with_sharding_constraint(
+                        jnp.take(x, sel_idx, axis=0), client_sharding
+                    )
+
+                return round_program(
+                    global_params,
+                    weights,
+                    rngs,
+                    jax.tree.map(take, data),
+                    jax.tree.map(take, val),
+                )
+
+            self._gather_program_fn = gather_round_program
+            self._jitted_gather_round_fn = jax.jit(
+                gather_round_program, donate_argnums=(0,)
+            )
+
+        def fn(global_params, weights, rngs, sel_idx=None):
+            if sel_idx is not None:
+                return self._jitted_gather_round_fn(
+                    global_params,
+                    weights,
+                    rngs,
+                    sel_idx,
+                    self._data,
+                    self._val_data or {},
+                )
             return jitted(
                 global_params, weights, rngs, self._data, self._val_data or {}
             )
@@ -732,36 +839,55 @@ class SpmdFedAvgSession:
         engine = self.engine
         n_slots = self.n_slots
         round_program = self._round_program_fn
+        gather_program = self._gather_program_fn
+        use_gather = self._selection_gather
         with_confusion = bool(self.config.use_slow_performance_metrics)
 
-        def horizon_program(global_params, rng, weight_rows, data, val, eval_batches):
-            def body(carry, weights):
+        def horizon_program(
+            global_params, rng, weight_rows, idx_rows, data, val, eval_batches
+        ):
+            def body(carry, xs):
                 params, rng = carry
                 rng, round_rng = jax.random.split(rng)
-                client_rngs = jax.vmap(
-                    lambda i: jax.random.fold_in(round_rng, i)
-                )(jnp.arange(n_slots))
-                params, train_metrics = round_program(
-                    params, weights, client_rngs, data, val
-                )
+                if use_gather:
+                    # selection-aware: the scanned ``[s_pad]`` id row folds
+                    # the SAME per-worker streams the dense path would, and
+                    # the gather program trains only the selected slots
+                    weights, sel_idx = xs
+                    client_rngs = jax.vmap(
+                        lambda i: jax.random.fold_in(round_rng, i)
+                    )(sel_idx)
+                    params, train_metrics = gather_program(
+                        params, weights, client_rngs, sel_idx, data, val
+                    )
+                else:
+                    weights = xs
+                    client_rngs = jax.vmap(
+                        lambda i: jax.random.fold_in(round_rng, i)
+                    )(jnp.arange(n_slots))
+                    params, train_metrics = round_program(
+                        params, weights, client_rngs, data, val
+                    )
                 eval_summed = engine.eval_fn(params, eval_batches)
                 outs = (train_metrics, eval_summed)
                 if with_confusion:
                     outs = outs + (engine.confusion_fn(params, eval_batches),)
                 return (params, rng), outs
 
+            xs = (weight_rows, idx_rows) if use_gather else weight_rows
             (global_params, rng), outs = jax.lax.scan(
-                body, (global_params, rng), weight_rows, length=horizon
+                body, (global_params, rng), xs, length=horizon
             )
             return (global_params, rng), outs
 
         jitted = jax.jit(horizon_program, donate_argnums=(0, 1))
 
-        def fn(global_params, rng, weight_rows):
+        def fn(global_params, rng, weight_rows, idx_rows=None):
             return jitted(
                 global_params,
                 rng,
                 weight_rows,
+                idx_rows,
                 self._data,
                 self._val_data or {},
                 self._ensure_eval_batches(),
@@ -792,7 +918,13 @@ class SpmdFedAvgSession:
             if isinstance(cost, (list, tuple)):
                 cost = cost[0] if cost else {}
             step_flops = float(cost.get("flops", 0.0))
-            steps = self.config.worker_number * self.config.epoch * self.n_batches
+            # MFU honesty: price only the clients whose contribution can
+            # reach the aggregate — min(worker_number, random_client_number)
+            # — so the dense path's zero-weight slot compute is WASTE, not
+            # credited FLOPs (``wasted_compute_fraction`` reports it)
+            steps = (
+                self._selected_per_round * self.config.epoch * self.n_batches
+            )
             return step_flops * steps
         except Exception:  # noqa: BLE001 — bench robustness over precision
             return 0.0
@@ -811,6 +943,56 @@ class SpmdFedAvgSession:
         for worker_id in selected:
             weights[worker_id] = self._dataset_sizes[worker_id]
         return weights
+
+    def _select_indices(
+        self, round_number: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Host-side selection for the gather path: the round's selected
+        worker ids (ascending — the dense path's slot order, so the
+        weighted reduction sees the contributions in the same order) padded
+        to the static ``s_pad`` with id 0 at weight 0, plus their
+        aggregation weights."""
+        from ..utils.selection import select_workers
+
+        selected = sorted(
+            select_workers(
+                self.config.seed,
+                round_number,
+                self.config.worker_number,
+                self.config.algorithm_kwargs.get("random_client_number"),
+            )
+        )
+        idx = np.zeros(self.s_pad, np.int32)
+        idx[: len(selected)] = selected
+        weights = np.zeros(self.s_pad, np.float32)
+        weights[: len(selected)] = self._dataset_sizes[selected]
+        return idx, weights
+
+    def _prepare_round_inputs(self, round_number: int, round_rng):
+        """Device inputs for ONE round program invocation:
+        ``(host_weights, weights, client_rngs, sel_idx)`` — ``sel_idx`` is
+        None on the dense path.  Shared by ``run()`` and bench drivers so
+        both exercise the session's actual selection path."""
+        if self._selection_gather:
+            host_idx, host_weights = self._select_indices(round_number)
+            sel_idx = put_sharded(host_idx, self._client_sharding)
+            weights = put_sharded(host_weights, self._client_sharding)
+            client_rngs = self._fold_sel_rngs(round_rng, sel_idx)
+        else:
+            sel_idx = None
+            host_weights = self._select_weights(round_number)
+            weights = put_sharded(host_weights, self._client_sharding)
+            client_rngs = self._fold_rngs(round_rng)
+        return host_weights, weights, client_rngs, sel_idx
+
+    @property
+    def wasted_compute_fraction(self) -> float:
+        """Fraction of the round program's client-slot compute whose
+        aggregation weight is zero (unselected slots + padding): the dense
+        path trains ``n_slots`` for ``selected`` useful contributions, the
+        gather path trains ``s_pad``."""
+        trained = self.s_pad if self._selection_gather else self.n_slots
+        return 1.0 - self._selected_per_round / max(trained, 1)
 
     def _init_global_params(self):
         """Initial params + first round: resume from a previous session's
@@ -871,8 +1053,6 @@ class SpmdFedAvgSession:
         with self._ckpt:  # flush pending writes at exit, surface errors
             for round_number in range(start_round, config.round + 1):
                 start = _time.monotonic()
-                host_weights = self._select_weights(round_number)
-                weights = put_sharded(host_weights, self._client_sharding)
                 rng, round_rng = jax.random.split(rng)
                 # per-client streams by fold_in, NOT split(round_rng, n):
                 # fold_in is indexed by WORKER ID alone, so the stream is
@@ -880,15 +1060,21 @@ class SpmdFedAvgSession:
                 # executor derives the identical stream per worker
                 # (engine/executor.py::aligned_round_stream) and the
                 # cross-executor parity test pins fed_avg trajectories.
-                # The chain stays device-resident (no host bounce).
-                client_rngs = self._fold_rngs(round_rng)
+                # The chain stays device-resident (no host bounce).  On the
+                # selection-gather path the same streams are folded for the
+                # selected ids only.
+                host_weights, weights, client_rngs, sel_idx = (
+                    self._prepare_round_inputs(round_number, round_rng)
+                )
                 self.dispatch_count += 1
                 # old global_params are donated into the round program —
                 # any pending background fetch of them must finish first
                 self._ckpt.barrier()
                 global_params, train_metrics = self._watchdog.call(
-                    lambda gp=global_params, w=weights, r=client_rngs: self._round_fn(
-                        gp, w, r
+                    lambda gp=global_params, w=weights, r=client_rngs, i=sel_idx: (
+                        self._round_fn(gp, w, r)
+                        if i is None
+                        else self._round_fn(gp, w, r, i)
                     ),
                     phase="round",
                     round_number=round_number,
@@ -969,12 +1155,26 @@ class SpmdFedAvgSession:
                     fn = self._horizon_fns[h] = self._build_horizon_fn(h)
                 start = _time.monotonic()
                 boundary = round_number + h - 1
-                host_weights = np.stack(
-                    [
-                        self._select_weights(r)
+                if self._selection_gather:
+                    # host-precomputed [H, s_pad] id + weight matrices —
+                    # the fused program gathers per scanned round
+                    pairs = [
+                        self._select_indices(r)
                         for r in range(round_number, round_number + h)
                     ]
-                )
+                    host_weights = np.stack([w for _i, w in pairs])
+                    idx_rows = put_sharded(
+                        np.stack([i for i, _w in pairs]),
+                        self._horizon_weight_sharding,
+                    )
+                else:
+                    idx_rows = None
+                    host_weights = np.stack(
+                        [
+                            self._select_weights(r)
+                            for r in range(round_number, round_number + h)
+                        ]
+                    )
                 weight_rows = put_sharded(
                     host_weights, self._horizon_weight_sharding
                 )
@@ -982,7 +1182,9 @@ class SpmdFedAvgSession:
                 # program — pending background fetches must finish first
                 self._ckpt.barrier()
                 (global_params, rng), outs = self._watchdog.call(
-                    lambda gp=global_params, r=rng, w=weight_rows: fn(gp, r, w),
+                    lambda gp=global_params, r=rng, w=weight_rows, i=idx_rows: fn(
+                        gp, r, w, i
+                    ),
                     phase="round",
                     round_number=boundary,
                 )
@@ -1200,6 +1402,38 @@ class SpmdSignSGDSession:
         self.round_horizon = max(
             1, int(config.algorithm_kwargs.get("round_horizon", 1) or 1)
         )
+        # selection-aware gather, sign-SGD flavor: the reference sign-SGD
+        # substrate is full-participation, but when
+        # ``random_client_number`` caps the per-round cohort the dense
+        # program would still train every slot and zero-mask the vote —
+        # the gather path trains only the ``s_pad`` gathered slots.  The
+        # dense escape hatch (``selection_gather: false``) honors the same
+        # per-round selection as 0/1 weight rows, so the two paths train
+        # identical trajectories (votes are small-integer sums — exact).
+        k = config.algorithm_kwargs.get("random_client_number")
+        self._selected_per_round = min(
+            int(k) if k is not None else config.worker_number,
+            config.worker_number,
+        )
+        self._selection_active = (
+            k is not None and int(k) < config.worker_number
+        )
+        sg_requested = config.algorithm_kwargs.get("selection_gather")
+        self._selection_gather = bool(
+            self._selection_active and sg_requested is not False
+        )
+        if sg_requested and not self._selection_gather:
+            get_logger().warning(
+                "selection_gather requested but unsupported: full"
+                " participation (no random_client_number below"
+                " worker_number) — nothing to skip; falling back to the"
+                " dense O(population) round path"
+            )
+        self.s_pad = (
+            client_slots(self._selected_per_round, self.mesh)
+            if self._selection_gather
+            else self.n_slots
+        )
 
         self._data, self._dataset_sizes, self.n_batches = stack_client_data(
             config, dataset_collection, practitioners, self.n_slots
@@ -1223,6 +1457,13 @@ class SpmdSignSGDSession:
         hp = engine.hyper_parameter
         momentum = hp.momentum
         schedule = hp.make_schedule(epochs * n_batches)
+        # metric masking only when selection is ACTIVE: the
+        # full-participation program keeps the historical unmasked sum
+        # (padding slots contribute count 0 anyway) so existing
+        # trajectories stay bit-identical; under selection, unselected
+        # clients must not leak into the recorded train curves (the
+        # gather path never trains them at all)
+        mask_metrics = self._selection_active
 
         def shard_body(params, data, weights, rngs):
             # data: [n_batches, slots_local, B, ...]; weights/rngs: [slots_local(, 2)]
@@ -1263,7 +1504,12 @@ class SpmdSignSGDSession:
                     velocity,
                 )
                 metrics = jax.tree.map(
-                    lambda m: jax.lax.psum(jnp.sum(m, axis=0), axis_name="clients"),
+                    lambda m: jax.lax.psum(
+                        jnp.sum(m * weights, axis=0)
+                        if mask_metrics
+                        else jnp.sum(m, axis=0),
+                        axis_name="clients",
+                    ),
                     metrics,
                 )
                 return (params, velocity, step + 1), metrics
@@ -1289,7 +1535,35 @@ class SpmdSignSGDSession:
         # data as an argument, not a closure constant (see _build_round_fn)
         jitted = jax.jit(run_program, donate_argnums=(0,))
 
-        def fn(params, weights, rngs):
+        self._gather_program_fn = None
+        self._jitted_gather_run_fn = None
+        if self._selection_gather:
+            batch_major_sharding = NamedSharding(self.mesh, P(None, "clients"))
+
+            def gather_run_program(params, weights, rngs, sel_idx, data):
+                """The SAME run program over the gathered ``[s_pad]``
+                cohort: device-side ``jnp.take`` along the (batch-major)
+                slot axis, then the identical shard_map body."""
+
+                def take(x):
+                    return jax.lax.with_sharding_constraint(
+                        jnp.take(x, sel_idx, axis=1), batch_major_sharding
+                    )
+
+                return run_program(
+                    params, weights, rngs, jax.tree.map(take, data)
+                )
+
+            self._gather_program_fn = gather_run_program
+            self._jitted_gather_run_fn = jax.jit(
+                gather_run_program, donate_argnums=(0,)
+            )
+
+        def fn(params, weights, rngs, sel_idx=None):
+            if sel_idx is not None:
+                return self._jitted_gather_run_fn(
+                    params, weights, rngs, sel_idx, self._data
+                )
             return jitted(params, weights, rngs, self._data)
 
         return fn
@@ -1301,25 +1575,101 @@ class SpmdSignSGDSession:
         each round evaluates in-program on the device-resident test set."""
         engine = self.engine
         run_program = self._run_program_fn
+        gather_program = self._gather_program_fn
+        use_gather = self._selection_gather
+        per_round_weights = self._selection_active
         with_confusion = bool(self.config.use_slow_performance_metrics)
 
-        def horizon_program(params, rng_rows, weights, data, eval_batches):
-            def body(params, rngs):
-                params, epoch_metrics = run_program(params, weights, rngs, data)
+        def horizon_program(params, rng_rows, weights, idx_rows, data, eval_batches):
+            # scanned per-round inputs: always the rng rows; under active
+            # selection also the 0/1 weight rows; under gather also the
+            # [H, s_pad] id rows (the body gathers the round's cohort)
+            def body(params, xs):
+                if use_gather:
+                    rngs, round_weights, sel_idx = xs
+                    params, epoch_metrics = gather_program(
+                        params, round_weights, rngs, sel_idx, data
+                    )
+                elif per_round_weights:
+                    rngs, round_weights = xs
+                    params, epoch_metrics = run_program(
+                        params, round_weights, rngs, data
+                    )
+                else:
+                    rngs = xs
+                    params, epoch_metrics = run_program(
+                        params, weights, rngs, data
+                    )
                 outs = (epoch_metrics, engine.eval_fn(params, eval_batches))
                 if with_confusion:
                     outs = outs + (engine.confusion_fn(params, eval_batches),)
                 return params, outs
 
-            return jax.lax.scan(body, params, rng_rows, length=horizon)
+            if use_gather:
+                xs = (rng_rows, weights, idx_rows)
+            elif per_round_weights:
+                xs = (rng_rows, weights)
+            else:
+                xs = rng_rows
+            return jax.lax.scan(body, params, xs, length=horizon)
 
         jitted = jax.jit(horizon_program, donate_argnums=(0,))
 
-        def fn(params, rng_rows, weights, eval_batches):
-            return jitted(params, rng_rows, weights, self._data, eval_batches)
+        def fn(params, rng_rows, weights, eval_batches, idx_rows=None):
+            return jitted(
+                params, rng_rows, weights, idx_rows, self._data, eval_batches
+            )
 
         fn._jitted = jitted
         return fn
+
+    def _round_weights(self, round_number: int) -> np.ndarray:
+        """[n_slots] 0/1 participation weights for the DENSE program: real
+        workers, intersected with the round's selection when
+        ``random_client_number`` is active."""
+        base = (self._dataset_sizes > 0).astype(np.float32)
+        if not self._selection_active:
+            return base
+        from ..utils.selection import select_workers
+
+        selected = select_workers(
+            self.config.seed,
+            round_number,
+            self.config.worker_number,
+            self.config.algorithm_kwargs.get("random_client_number"),
+        )
+        mask = np.zeros(self.n_slots, np.float32)
+        mask[sorted(selected)] = 1.0
+        return base * mask
+
+    def _select_indices(
+        self, round_number: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Gather-path selection: ascending selected worker ids padded to
+        ``s_pad`` (id 0 at weight 0), plus their 0/1 vote weights."""
+        from ..utils.selection import select_workers
+
+        selected = sorted(
+            select_workers(
+                self.config.seed,
+                round_number,
+                self.config.worker_number,
+                self.config.algorithm_kwargs.get("random_client_number"),
+            )
+        )
+        idx = np.zeros(self.s_pad, np.int32)
+        idx[: len(selected)] = selected
+        weights = np.zeros(self.s_pad, np.float32)
+        weights[: len(selected)] = (
+            self._dataset_sizes[selected] > 0
+        ).astype(np.float32)
+        return idx, weights
+
+    @property
+    def wasted_compute_fraction(self) -> float:
+        """See :meth:`SpmdFedAvgSession.wasted_compute_fraction`."""
+        trained = self.s_pad if self._selection_gather else self.n_slots
+        return 1.0 - self._selected_per_round / max(trained, 1)
 
     def _note_round(self, round_number: int, metric, epoch_metrics) -> None:
         """One round's stat row (identical surface on the per-round and
@@ -1377,14 +1727,33 @@ class SpmdSignSGDSession:
         params, weights, batches, save_dir = self._run_setup()
         best_acc = -1.0
         for round_number in range(1, config.round + 1):
-            rngs = put_sharded(
+            # same per-round streams on every path: split(PRNGKey(seed +
+            # round), n_slots) indexed by worker id — the gather path takes
+            # the selected rows of the identical host split
+            host_rngs = np.asarray(
                 jax.random.split(
                     jax.random.PRNGKey(config.seed + round_number), self.n_slots
-                ),
-                self._client_sharding,
+                )
             )
+            if self._selection_gather:
+                host_idx, host_w = self._select_indices(round_number)
+                sel_idx = put_sharded(host_idx, self._client_sharding)
+                round_weights = put_sharded(host_w, self._client_sharding)
+                rngs = put_sharded(host_rngs[host_idx], self._client_sharding)
+            elif self._selection_active:
+                sel_idx = None
+                round_weights = put_sharded(
+                    self._round_weights(round_number), self._client_sharding
+                )
+                rngs = put_sharded(host_rngs, self._client_sharding)
+            else:
+                sel_idx = None
+                round_weights = weights
+                rngs = put_sharded(host_rngs, self._client_sharding)
             params, epoch_metrics = self._watchdog.call(
-                lambda p=params, w=weights, r=rngs: self._run_fn(p, w, r),
+                lambda p=params, w=round_weights, r=rngs, i=sel_idx: (
+                    self._run_fn(p, w, r, i)
+                ),
                 phase="round",
                 round_number=round_number,
             )
@@ -1431,23 +1800,40 @@ class SpmdSignSGDSession:
                 fn = self._horizon_fns[h] = self._build_horizon_fn(h)
             boundary = round_number + h - 1
             # same per-round streams as H=1: PRNGKey(seed + round), split
-            # to slots — stacked into [H, n_slots, 2] scan rows
-            rng_rows = put_sharded(
-                np.stack(
-                    [
-                        np.asarray(
-                            jax.random.split(
-                                jax.random.PRNGKey(config.seed + r),
-                                self.n_slots,
-                            )
-                        )
-                        for r in range(round_number, round_number + h)
-                    ]
-                ),
-                rng_sharding,
-            )
+            # to slots — stacked into [H, n_slots, 2] scan rows (gather:
+            # the selected rows of the identical splits, [H, s_pad, 2])
+            rounds = range(round_number, round_number + h)
+            host_rng_rows = [
+                np.asarray(
+                    jax.random.split(
+                        jax.random.PRNGKey(config.seed + r), self.n_slots
+                    )
+                )
+                for r in rounds
+            ]
+            idx_rows = None
+            weight_arg = weights
+            if self._selection_gather:
+                pairs = [self._select_indices(r) for r in rounds]
+                host_rng_rows = [
+                    row[idx] for row, (idx, _w) in zip(host_rng_rows, pairs)
+                ]
+                idx_rows = put_sharded(
+                    np.stack([i for i, _w in pairs]), rng_sharding
+                )
+                weight_arg = put_sharded(
+                    np.stack([w for _i, w in pairs]), rng_sharding
+                )
+            elif self._selection_active:
+                weight_arg = put_sharded(
+                    np.stack([self._round_weights(r) for r in rounds]),
+                    rng_sharding,
+                )
+            rng_rows = put_sharded(np.stack(host_rng_rows), rng_sharding)
             params, outs = self._watchdog.call(
-                lambda p=params, rr=rng_rows: fn(p, rr, weights, batches),
+                lambda p=params, rr=rng_rows, w=weight_arg, i=idx_rows: fn(
+                    p, rr, w, batches, i
+                ),
                 phase="round",
                 round_number=boundary,
             )

@@ -48,6 +48,28 @@ def test_bench_main_prints_one_json_line(monkeypatch):
             "speedup": 1.5,
         },
     )
+    monkeypatch.setattr(
+        bench,
+        "measure_selection_gather",
+        lambda: {
+            "workers": bench.SEL_WORKERS,
+            "selected_per_round": bench.SEL_SELECTED,
+            "gather": {
+                "rounds_per_sec": 0.9,
+                "selection_path": "gather",
+                "s_pad": 100,
+                "wasted_compute_fraction": 0.0,
+            },
+            "dense": {
+                "rounds_per_sec": 0.1,
+                "selection_path": "dense",
+                "s_pad": 1000,
+                "wasted_compute_fraction": 0.9,
+            },
+            "speedup": 9.0,
+            "wasted_compute_fraction": 0.0,
+        },
+    )
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     bench.main()
@@ -69,10 +91,20 @@ def test_bench_main_prints_one_json_line(monkeypatch):
         "dispatches_per_round",
         "host_sync_points",
         "dispatch_budget",
+        "selection_path",
+        "wasted_compute_fraction",
+        "selection",
     ):
         assert field in payload, field
     assert payload["metric"] == "fedavg_cifar10_100clients_rounds_per_sec"
     assert payload["agg_path"] in ("flat", "per_tensor")
+    # selection-aware gather: the A/B carries both paths' rounds/sec and
+    # wasted-compute fractions; the top-level pair mirrors the default
+    # (gather) path
+    assert payload["selection_path"] == "gather"
+    assert payload["wasted_compute_fraction"] == 0.0
+    assert payload["selection"]["speedup"] == 9.0
+    assert payload["selection"]["dense"]["wasted_compute_fraction"] == 0.9
     # aggregation wall time is reported per round, separately per path
     assert "flat_s_per_round" in payload["aggregation"]
     # the headline dispatch-budget pair comes from the FUSED run: one
@@ -97,6 +129,7 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     monkeypatch.setattr(bench, "measure_large_scale", boom)
     monkeypatch.setattr(bench, "measure_aggregation", boom)
     monkeypatch.setattr(bench, "measure_round_horizon", boom)
+    monkeypatch.setattr(bench, "measure_selection_gather", boom)
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     bench.main()
@@ -112,3 +145,8 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     # the headline pair degrades to 0.0, never a missing field
     assert payload["dispatches_per_round"] == 0.0
     assert payload["host_sync_points"] == 0.0
+    # selection A/B degrades to an error marker with the default-path
+    # fields still present
+    assert "error" in payload["selection"]
+    assert payload["selection_path"] == "gather"
+    assert payload["wasted_compute_fraction"] == 0.0

@@ -6,6 +6,11 @@ tasks through the public ``train(practitioners=...)`` /
 from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
 from distributed_learning_simulator_tpu.practitioner import create_practitioners
 from distributed_learning_simulator_tpu.training import get_training_result, train
+import pytest
+
+# heavy e2e: excluded from the tier-1 CI budget (-m 'not slow'),
+# still runs in a plain `pytest tests/` (see tests/conftest.py)
+pytestmark = pytest.mark.slow
 
 
 def test_concurrent_tasks(tmp_session_dir):

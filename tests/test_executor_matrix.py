@@ -16,6 +16,10 @@ from distributed_learning_simulator_tpu.training import (
     train,
 )
 
+# heavy e2e: excluded from the tier-1 CI budget (-m 'not slow'),
+# still runs in a plain `pytest tests/` (see tests/conftest.py)
+pytestmark = pytest.mark.slow
+
 VISION = dict(
     dataset_name="MNIST",
     model_name="LeNet5",

@@ -8,9 +8,20 @@ Tolerance: the round program sums K≈slots float32 client contributions
 before one psum and a divide, so the worst-case relative error vs the f64
 stream is a few float32 ulps per addition — ≤ 1e-6 · max|leaf| is enforced
 (8 slots × 1.2e-7 ulp ≈ 1e-6).
+
+Two host-replay contracts these tests pin (both bit us before):
+
+* the per-client rng streams are the ``run()`` loop's fold_in chain
+  (``fold_in(round_rng, worker_id)``) — NOT ``split(round_rng, n_slots)``,
+  whose prefixes depend on the padded slot count;
+* host snapshots of device params must be REAL copies: ``np.asarray`` of a
+  replicated cpu-backend array is a zero-copy VIEW of the device buffer,
+  and the round program DONATES its params argument — XLA reuses the
+  buffer and the "snapshot" silently mutates under the replay.
 """
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from distributed_learning_simulator_tpu.native import Float64Accumulator
@@ -42,20 +53,25 @@ def test_spmd_round_matches_host_f64_stream(tmp_session_dir):
         ctx.config, ctx.dataset_collection, ctx.model_ctx, ctx.engine, ctx.practitioners
     )
 
-    # reproduce run()'s round-1 inputs exactly (spmd.py::run)
+    # reproduce run()'s round-1 inputs exactly (spmd.py::run): the fold_in
+    # chain, and REAL host copies (np.array) — global_params is donated
     global_params, _ = session._init_global_params()
-    host_global = {k: np.asarray(v) for k, v in global_params.items()}
+    host_global = {k: np.array(v, copy=True) for k, v in global_params.items()}
     host_weights = session._select_weights(1)
     rng = jax.random.PRNGKey(config.seed)
     _, round_rng = jax.random.split(rng)
-    client_rngs = jax.random.split(round_rng, session.n_slots)
+    client_rngs = np.asarray(
+        jax.vmap(lambda i: jax.random.fold_in(round_rng, i))(
+            jnp.arange(session.n_slots)
+        )
+    )
 
     from distributed_learning_simulator_tpu.parallel.mesh import put_sharded
 
     new_global, _ = session._round_fn(
         global_params,
         put_sharded(host_weights, session._client_sharding),
-        put_sharded(np.asarray(client_rngs), session._client_sharding),
+        put_sharded(client_rngs, session._client_sharding),
     )
     spmd_flat = _flatten(new_global)
 
@@ -69,7 +85,8 @@ def test_spmd_round_matches_host_f64_stream(tmp_session_dir):
     for c in range(session.n_slots):
         if host_weights[c] == 0:
             continue
-        slot_rng, _ = jax.random.split(client_rngs[c])  # local_train splits first
+        # local_train splits first
+        slot_rng, _ = jax.random.split(jnp.asarray(client_rngs[c]))
         slot_data = jax.tree.map(lambda x, c=c: x[c], host_data)
         client_params = local_fn(host_global, slot_data, slot_rng)
         acc.add(_flatten(client_params), float(host_weights[c]))
@@ -98,18 +115,22 @@ def test_spmd_round_matches_host_f64_per_leaf(tmp_session_dir):
         ctx.config, ctx.dataset_collection, ctx.model_ctx, ctx.engine, ctx.practitioners
     )
     global_params, _ = session._init_global_params()
-    host_global = {k: np.asarray(v) for k, v in global_params.items()}
+    host_global = {k: np.array(v, copy=True) for k, v in global_params.items()}
     host_weights = session._select_weights(1)
     assert (host_weights > 0).sum() == 5
     _, round_rng = jax.random.split(jax.random.PRNGKey(config.seed))
-    client_rngs = jax.random.split(round_rng, session.n_slots)
+    client_rngs = np.asarray(
+        jax.vmap(lambda i: jax.random.fold_in(round_rng, i))(
+            jnp.arange(session.n_slots)
+        )
+    )
 
     from distributed_learning_simulator_tpu.parallel.mesh import put_sharded
 
     new_global, _ = session._round_fn(
         global_params,
         put_sharded(host_weights, session._client_sharding),
-        put_sharded(np.asarray(client_rngs), session._client_sharding),
+        put_sharded(client_rngs, session._client_sharding),
     )
 
     host_data = jax.tree.map(lambda x: np.asarray(x), session._data)
@@ -120,7 +141,7 @@ def test_spmd_round_matches_host_f64_per_leaf(tmp_session_dir):
     for c in range(session.n_slots):
         if host_weights[c] == 0:
             continue
-        slot_rng, _ = jax.random.split(client_rngs[c])
+        slot_rng, _ = jax.random.split(jnp.asarray(client_rngs[c]))
         slot_data = jax.tree.map(lambda x, c=c: x[c], host_data)
         client_results[c] = jax.tree.map(
             np.asarray, local_fn(host_global, slot_data, slot_rng)

@@ -11,6 +11,10 @@ from distributed_learning_simulator_tpu.data import create_dataset_collection
 from distributed_learning_simulator_tpu.ml_type import MachineLearningPhase as Phase
 from distributed_learning_simulator_tpu.models import create_model_context
 
+# heavy e2e: excluded from the tier-1 CI budget (-m 'not slow'),
+# still runs in a plain `pytest tests/` (see tests/conftest.py)
+pytestmark = pytest.mark.slow
+
 
 def _build(dataset, model, dataset_kwargs=None, model_kwargs=None, init=True):
     config = DistributedTrainingConfig(

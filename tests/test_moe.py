@@ -16,6 +16,11 @@ from distributed_learning_simulator_tpu.models.moe import (
     MoEFeedForward,
     MoETransformerClassifier,
 )
+import pytest
+
+# heavy e2e: excluded from the tier-1 CI budget (-m 'not slow'),
+# still runs in a plain `pytest tests/` (see tests/conftest.py)
+pytestmark = pytest.mark.slow
 
 
 def test_routing_dispatch_math():

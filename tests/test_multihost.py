@@ -11,6 +11,10 @@ import sys
 
 import pytest
 
+# heavy e2e: excluded from the tier-1 CI budget (-m 'not slow'),
+# still runs in a plain `pytest tests/` (see tests/conftest.py)
+pytestmark = pytest.mark.slow
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO_ROOT, "tests", "multihost_worker.py")
 

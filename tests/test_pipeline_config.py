@@ -12,6 +12,10 @@ import pytest
 from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
 from distributed_learning_simulator_tpu.training import train
 
+# heavy e2e: excluded from the tier-1 CI budget (-m 'not slow'),
+# still runs in a plain `pytest tests/` (see tests/conftest.py)
+pytestmark = pytest.mark.slow
+
 
 def _config(**model_extra):
     return DistributedTrainingConfig(

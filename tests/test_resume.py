@@ -6,6 +6,11 @@ import os
 
 from conftest import fed_avg_config as _config
 from distributed_learning_simulator_tpu.training import train
+import pytest
+
+# heavy e2e: excluded from the tier-1 CI budget (-m 'not slow'),
+# still runs in a plain `pytest tests/` (see tests/conftest.py)
+pytestmark = pytest.mark.slow
 
 
 def test_resume_from_previous_session(tmp_session_dir):

@@ -1,0 +1,243 @@
+"""Selection-aware client gather (``algorithm_kwargs.selection_gather``):
+round compute scales with the SELECTED cohort, not the population, and the
+trajectory must be a pure scheduling change — bit-identical params and
+metrics vs the dense zero-masking path, per-round and fused-horizon, with
+static shapes (one compile, no retrace as the selected ids change round to
+round) and loud dense fallbacks where the gather cannot apply (FSDP, full
+participation).
+
+Bit-exactness note: the pins below run 8 workers on the 8-device test mesh
+(one slot per device), where the weighted reduction sees the selected
+contributions in identical order on both paths, so equality is structural.
+At >1 slots/device the reduction GROUPING differs (dense sums each
+device's slot block before the cross-device psum) — a float-tolerance pin
+covers that shape.
+"""
+
+import logging
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import fed_avg_config
+from distributed_learning_simulator_tpu.parallel.mesh import make_mesh
+from distributed_learning_simulator_tpu.parallel.spmd import (
+    SpmdFedAvgSession,
+    SpmdSignSGDSession,
+)
+from distributed_learning_simulator_tpu.training import _build_task, train
+from distributed_learning_simulator_tpu.utils.logging import get_logger
+
+
+def _config(gather, save_dir, rounds=4, horizon=1, k=5, workers=8, **overrides):
+    algorithm_kwargs = dict(overrides.pop("algorithm_kwargs", {}))
+    algorithm_kwargs["selection_gather"] = gather
+    if k is not None:
+        algorithm_kwargs["random_client_number"] = k
+    if horizon != 1:
+        algorithm_kwargs["round_horizon"] = horizon
+    config = fed_avg_config(
+        executor="spmd",
+        worker_number=workers,
+        round=rounds,
+        batch_size=32,
+        epoch=1,
+        dataset_kwargs={
+            "train_size": 32 * workers,
+            "val_size": 32,
+            "test_size": 32,
+        },
+        algorithm_kwargs=algorithm_kwargs,
+        save_dir=save_dir,
+        log_file=os.path.join(save_dir, "run.log"),
+        **overrides,
+    )
+    config.load_config_and_process()
+    return config
+
+
+def _final_params(save_dir, round_number):
+    path = os.path.join(
+        save_dir, "aggregated_model", f"round_{round_number}.npz"
+    )
+    with np.load(path) as blob:
+        return {k: blob[k] for k in blob.files}
+
+
+def _assert_bit_exact(dense, gathered, dense_dir, gather_dir, rounds):
+    assert set(dense["performance"]) == set(gathered["performance"])
+    for rn in sorted(dense["performance"]):
+        a, b = dense["performance"][rn], gathered["performance"][rn]
+        assert a["test_accuracy"] == b["test_accuracy"], (rn, a, b)
+        assert a["test_loss"] == b["test_loss"], (rn, a, b)
+    pa = _final_params(dense_dir, rounds)
+    pb = _final_params(gather_dir, rounds)
+    assert pa.keys() == pb.keys()
+    for key in pa:
+        np.testing.assert_array_equal(pa[key], pb[key], err_msg=key)
+
+
+def test_gather_vs_dense_bit_exact_per_round(tmp_session_dir):
+    """The acceptance pin, H=1: the gather path trains s_pad=8 gathered
+    slots (5 selected + 3 zero-weight pads) and must reproduce the dense
+    path's trajectory bit-exactly — every round's test metrics and the
+    final aggregated params."""
+    dense = train(_config(False, "dense"))
+    gathered = train(_config(True, "gather"))
+    _assert_bit_exact(dense, gathered, "dense", "gather", rounds=4)
+
+
+def test_gather_vs_dense_bit_exact_fused_horizon(tmp_session_dir):
+    """The acceptance pin, H=8: the [H, s_pad] id matrix rides the fused
+    scan and the in-program fold re-derives the identical per-worker
+    streams."""
+    dense = train(_config(False, "dh", rounds=8, horizon=8))
+    gathered = train(_config(True, "gh", rounds=8, horizon=8))
+    _assert_bit_exact(dense, gathered, "dh", "gh", rounds=8)
+
+
+def test_fed_paq_gather_parity(tmp_session_dir):
+    """fed_paq rides the same round program (QSGD codec keyed by the
+    fold_in-derived quant rngs, so the gathered slots draw identical
+    codec noise)."""
+    dense = train(_config(False, "pd", distributed_algorithm="fed_paq"))
+    gathered = train(_config(True, "pg", distributed_algorithm="fed_paq"))
+    _assert_bit_exact(dense, gathered, "pd", "pg", rounds=4)
+
+
+def test_sign_sgd_gather_parity(tmp_session_dir):
+    """sign_SGD with an active selection: the dense escape hatch masks the
+    vote (and the train curves) by the round's 0/1 selection weights, the
+    gather path trains only the cohort — identical metrics and curves
+    (votes are small-integer sign sums: exact under reordering)."""
+    dense = train(_config(False, "sd", distributed_algorithm="sign_SGD"))
+    gathered = train(_config(True, "sg", distributed_algorithm="sign_SGD"))
+    assert set(dense["performance"]) == set(gathered["performance"])
+    for rn in sorted(dense["performance"]):
+        a, b = dense["performance"][rn], gathered["performance"][rn]
+        assert a["test_accuracy"] == b["test_accuracy"], rn
+        assert a["test_loss"] == b["test_loss"], rn
+        assert a["train_loss_per_epoch"] == b["train_loss_per_epoch"], rn
+        assert (
+            a["train_accuracy_per_epoch"] == b["train_accuracy_per_epoch"]
+        ), rn
+
+
+def test_sign_sgd_gather_parity_fused_horizon(tmp_session_dir):
+    dense = train(
+        _config(False, "shd", rounds=3, horizon=3, distributed_algorithm="sign_SGD")
+    )
+    gathered = train(
+        _config(True, "shg", rounds=3, horizon=3, distributed_algorithm="sign_SGD")
+    )
+    for rn in sorted(dense["performance"]):
+        a, b = dense["performance"][rn], gathered["performance"][rn]
+        assert a["test_accuracy"] == b["test_accuracy"], rn
+        assert a["train_loss_per_epoch"] == b["train_loss_per_epoch"], rn
+
+
+def test_compute_reduction_shape_close(tmp_session_dir):
+    """16 workers / 8 selected: s_pad=8 < n_slots=16 — the shape where the
+    gather actually halves the slot count.  The reduction grouping differs
+    (2 dense slots/device vs 1 gathered), so params match to float32-ulp
+    tolerance while the recorded metrics still coincide."""
+    dense = train(_config(False, "d16", workers=16, k=8))
+    gathered = train(_config(True, "g16", workers=16, k=8))
+    for rn in sorted(dense["performance"]):
+        a, b = dense["performance"][rn], gathered["performance"][rn]
+        assert a["test_count"] == b["test_count"], rn
+    pa = _final_params("d16", 4)
+    pb = _final_params("g16", 4)
+    for key in pa:
+        np.testing.assert_allclose(
+            pa[key], pb[key], rtol=0, atol=5e-6, err_msg=key
+        )
+
+
+def test_no_retrace_and_static_shapes_across_rounds(tmp_session_dir):
+    """The gather program compiles ONCE: per-round selections change the
+    index VALUES, never the shapes — s_pad stays fixed even when the
+    selected count (3) sits below it (8), padding rides at weight 0."""
+    config = _config(True, "nr", rounds=4, k=3)
+    ctx = _build_task(config)
+    session = SpmdFedAvgSession(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+    assert session._selection_gather
+    assert session.s_pad == 8  # 3 selected, padded to the 8-slot mesh axis
+    assert session.wasted_compute_fraction == pytest.approx(1 - 3 / 8)
+    for round_number in (1, 2, 3):
+        host_idx, host_weights = session._select_indices(round_number)
+        assert host_idx.shape == (session.s_pad,)
+        assert host_weights.shape == (session.s_pad,)
+        assert (host_weights > 0).sum() == 3
+    session.run()
+    assert session._jitted_gather_round_fn._cache_size() == 1
+    # the dense program was never traced on this session's run loop
+    assert session._jitted_round_fn._cache_size() == 0
+
+
+def test_fsdp_falls_back_loudly(tmp_session_dir):
+    """FSDP stores params in the dense slot layout — requesting the gather
+    must warn and run dense, not silently drop the flag."""
+    config = _config(True, "fsdp", workers=8)
+    ctx = _build_task(config)
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda r: records.append(r.getMessage())
+    logger = get_logger()
+    logger.addHandler(handler)
+    try:
+        session = SpmdFedAvgSession(
+            ctx.config,
+            ctx.dataset_collection,
+            ctx.model_ctx,
+            ctx.engine,
+            ctx.practitioners,
+            mesh=make_mesh(model_parallel=2),
+        )
+    finally:
+        logger.removeHandler(handler)
+    assert session._fsdp
+    assert not session._selection_gather
+    assert session.s_pad == session.n_slots
+    assert any("selection_gather" in m and "dense" in m for m in records)
+
+
+def test_full_participation_falls_back_loudly(tmp_session_dir):
+    """No random_client_number below worker_number — nothing to skip; the
+    explicit request warns and the dense path runs (both sessions)."""
+    for cls, alg in (
+        (SpmdFedAvgSession, "fed_avg"),
+        (SpmdSignSGDSession, "sign_SGD"),
+    ):
+        tag = f"full_{alg}"
+        config = _config(True, tag, k=None, distributed_algorithm=alg)
+        ctx = _build_task(config)
+        records = []
+        handler = logging.Handler()
+        handler.emit = lambda r: records.append(r.getMessage())
+        logger = get_logger()
+        logger.addHandler(handler)
+        try:
+            session = cls(
+                ctx.config,
+                ctx.dataset_collection,
+                ctx.model_ctx,
+                ctx.engine,
+                ctx.practitioners,
+            )
+        finally:
+            logger.removeHandler(handler)
+        assert not session._selection_gather, alg
+        assert session.s_pad == session.n_slots, alg
+        assert any(
+            "selection_gather" in m and "full participation" in m
+            for m in records
+        ), alg

@@ -4,6 +4,11 @@ import numpy as np
 
 from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
 from distributed_learning_simulator_tpu.training import train
+import pytest
+
+# heavy e2e: excluded from the tier-1 CI budget (-m 'not slow'),
+# still runs in a plain `pytest tests/` (see tests/conftest.py)
+pytestmark = pytest.mark.slow
 
 
 def _config(**kwargs):
