@@ -58,6 +58,7 @@ def make_config(
     dataset_extra: dict | None = None,
     rounds: int = 1,
     use_amp: bool = True,  # canonical large_scale configuration (bf16 MXU)
+    distributed_algorithm: str = "fed_avg",
     **extra,
 ):
     from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
@@ -66,7 +67,7 @@ def make_config(
     return DistributedTrainingConfig(
         dataset_name=dataset_name,
         model_name=model_name,
-        distributed_algorithm="fed_avg",
+        distributed_algorithm=distributed_algorithm,
         executor=executor,
         worker_number=workers,
         batch_size=batch_size,
@@ -329,6 +330,93 @@ def measure_round_horizon() -> dict:
     h1, hH = out["h1"], out[f"h{HZ_HORIZON}"]
     if h1["rounds_per_sec"]:
         out["speedup"] = round(hH["rounds_per_sec"] / h1["rounds_per_sec"], 3)
+    return out
+
+
+# FedOBD fused-round A/B (the canonical fed_obd CIFAR10/densenet40 shape at
+# reduced client count/round budget): the OBD sessions were the last hot
+# path still paying 3-4 dispatches + a blocking host sync per round and
+# training every slot densely under random_client_number.  Measures full
+# session.run() loops — dense/H=1 vs gather/H=OBD_HORIZON — and reports
+# rounds/sec, the speedup, and each arm's dispatch/host-sync counters so
+# the driver can pin dispatches_per_round < 1 for OBD under fusion.
+OBD_WORKERS = 10
+OBD_SELECTED = 5
+OBD_ROUNDS = 8
+OBD_PHASE2 = 4
+OBD_HORIZON = 4
+OBD_BATCH = 32
+
+
+def measure_obd_horizon() -> dict:
+    from distributed_learning_simulator_tpu.parallel.spmd_obd import (
+        SpmdFedOBDSession,
+    )
+    from distributed_learning_simulator_tpu.training import _build_task
+
+    out: dict = {
+        "model": "densenet40/CIFAR10",
+        "workers": OBD_WORKERS,
+        "selected_per_round": OBD_SELECTED,
+        "rounds": OBD_ROUNDS,
+        "second_phase_epoch": OBD_PHASE2,
+        "horizon": OBD_HORIZON,
+    }
+    for arm, (gather, horizon) in (
+        ("dense_h1", (False, 1)),
+        (f"gather_h{OBD_HORIZON}", (True, OBD_HORIZON)),
+    ):
+        config = make_config(
+            "spmd",
+            OBD_WORKERS,
+            OBD_WORKERS * OBD_BATCH,
+            batch_size=OBD_BATCH,
+            tag=f"obd_{arm}",
+            rounds=OBD_ROUNDS,
+            distributed_algorithm="fed_obd",
+            endpoint_kwargs={
+                "server": {"weight": 0.01},
+                "worker": {"weight": 0.01},
+            },
+            algorithm_kwargs={
+                "dropout_rate": 0.3,
+                "second_phase_epoch": OBD_PHASE2,
+                "random_client_number": OBD_SELECTED,
+                "selection_gather": gather,
+                "round_horizon": horizon,
+            },
+        )
+        ctx = _build_task(config)
+        session = SpmdFedOBDSession(
+            ctx.config,
+            ctx.dataset_collection,
+            ctx.model_ctx,
+            ctx.engine,
+            ctx.practitioners,
+        )
+        session.run()  # warmup: compiles the phase/horizon programs
+        session._stat.clear()
+        session.reset_dispatch_stats()
+        start = time.monotonic()
+        session.run()
+        elapsed = time.monotonic() - start
+        rounds = session.rounds_run or 1
+        out[arm] = {
+            "rounds_per_sec": round(rounds / elapsed, 4),
+            "dispatches_per_round": round(session.dispatches_per_round, 4),
+            "host_sync_points": round(session.host_sync_points, 4),
+            "selection_path": "gather" if session._selection_gather else "dense",
+            "s_pad": session.s_pad,
+            "wasted_compute_fraction": round(
+                session.wasted_compute_fraction, 4
+            ),
+        }
+    dense = out["dense_h1"]
+    fused = out[f"gather_h{OBD_HORIZON}"]
+    if dense["rounds_per_sec"]:
+        out["speedup"] = round(
+            fused["rounds_per_sec"] / dense["rounds_per_sec"], 3
+        )
     return out
 
 
@@ -709,6 +797,14 @@ def main() -> None:
     except Exception as exc:
         dispatch_budget = {"error": str(exc)[:200]}
     fused = dispatch_budget.get(f"h{HZ_HORIZON}", {})
+    # FedOBD fused-round A/B (dense/H=1 vs gather/H≥4 full session.run
+    # loops on the canonical OBD shape) — the last hot path to get the
+    # PR 2 + PR 3 machinery
+    try:
+        obd_fusion = measure_obd_horizon()
+    except Exception as exc:
+        obd_fusion = {"error": str(exc)[:200]}
+    obd_fused = obd_fusion.get(f"gather_h{OBD_HORIZON}", {})
     # canonical north-star workloads (VERDICT r4 item 7): full
     # gtg_shapley_train.sh / fed_obd_train.sh runs are ~1 h on-chip, so
     # they are measured once per machine by tools/run_canonical.py and
@@ -769,6 +865,24 @@ def main() -> None:
                 "dispatches_per_round": fused.get("dispatches_per_round", 0.0),
                 "host_sync_points": fused.get("host_sync_points", 0.0),
                 "dispatch_budget": dispatch_budget,
+                # FedOBD fusion: which path the two-phase OBD sessions
+                # take by default (gather + fused horizons) and the fused
+                # arm's dispatch budget — the dense/H=1 arm and the
+                # speedup live under obd_fusion
+                "obd_fusion_path": {
+                    "selection_path": obd_fused.get(
+                        "selection_path", "gather"
+                    ),
+                    "horizon": obd_fusion.get("horizon", OBD_HORIZON),
+                    "dispatches_per_round": obd_fused.get(
+                        "dispatches_per_round", 0.0
+                    ),
+                    "host_sync_points": obd_fused.get(
+                        "host_sync_points", 0.0
+                    ),
+                    "speedup": obd_fusion.get("speedup", 0.0),
+                },
+                "obd_fusion": obd_fusion,
                 "canonical": canonical,
             }
         )

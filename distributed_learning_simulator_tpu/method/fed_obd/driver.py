@@ -137,6 +137,14 @@ class ObdRoundDriver:
         assert spec is not None
         return self.second_phase_epoch if spec.epoch_cadence else self.total_rounds
 
+    @property
+    def remaining(self) -> int:
+        """Aggregations left in the current phase's budget — what a fused
+        dispatch may clamp its horizon to so phase switches always land on
+        horizon boundaries (plateau early-stop can still end a phase
+        sooner, which is why fusion runs per-round under ``early_stop``)."""
+        return 0 if self.finished else self.budget() - self._tick
+
     def stop_now(self) -> None:
         self._schedule.clear()
 

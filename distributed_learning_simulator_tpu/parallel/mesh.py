@@ -13,6 +13,21 @@ import numpy as np
 from jax.sharding import Mesh
 
 
+def use_mesh(mesh: Mesh):
+    """Ambient-mesh context manager, version-compat: sessions whose models
+    carry bare-``PartitionSpec`` sharding constraints (the MoE expert
+    layout) need an ambient mesh at trace time.  Newer jax spells this
+    ``jax.sharding.set_mesh``; on the jax 0.4 line that name does not
+    exist and the ``Mesh`` object itself is the context manager — calling
+    ``jax.sharding.set_mesh`` there raises ``AttributeError`` at the first
+    FedOBD/fed_avg expert-parallel round (the pre-existing ``set_mesh``
+    failure ROADMAP catalogued)."""
+    set_mesh = getattr(jax.sharding, "set_mesh", None)
+    if set_mesh is not None:
+        return set_mesh(mesh)
+    return mesh
+
+
 def make_mesh(model_parallel: int = 1, devices=None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)

@@ -403,8 +403,7 @@ class SpmdFedAvgSession:
         sg_requested = config.algorithm_kwargs.get("selection_gather")
         self._selection_gather = bool(
             selection_active
-            and type(self) is SpmdFedAvgSession
-            and not self._fsdp
+            and self._selection_gather_unsupported_reason() is None
             and sg_requested is not False
         )
         if sg_requested and not self._selection_gather:
@@ -413,14 +412,8 @@ class SpmdFedAvgSession:
                     "full participation (no random_client_number below"
                     " worker_number) — nothing to skip"
                 )
-            elif type(self) is not SpmdFedAvgSession:
-                reason = f"{type(self).__name__} builds its own round program"
             else:
-                reason = (
-                    "FSDP model sharding stores params in the dense slot"
-                    " layout (all-gather/reduce_scatter are population-"
-                    "shaped)"
-                )
+                reason = self._selection_gather_unsupported_reason()
             get_logger().warning(
                 "selection_gather requested but unsupported: %s — falling"
                 " back to the dense O(population) round path",
@@ -568,12 +561,33 @@ class SpmdFedAvgSession:
         self._jitted_gather_round_fn = None
         self._horizon_fns: dict[int, object] = {}
         self._round_fn = self._build_round_fn()
-        if self.round_horizon > 1 and self._round_program_fn is None:
+        if self.round_horizon > 1 and not self._horizon_capable():
             raise ValueError(
-                "round_horizon > 1 requires the base FedAvg round program;"
+                "round_horizon > 1 requires a fusable round program;"
                 f" {type(self).__name__} builds its own round function —"
                 " run it with round_horizon=1"
             )
+
+    def _selection_gather_unsupported_reason(self) -> str | None:
+        """Why this session cannot run the selection-aware gather (None =
+        supported).  Sessions that extend the gather to their own round
+        programs (FedOBD) override this."""
+        if type(self) is not SpmdFedAvgSession:
+            return f"{type(self).__name__} builds its own round program"
+        if self._fsdp:
+            return (
+                "FSDP model sharding stores params in the dense slot"
+                " layout (all-gather/reduce_scatter are population-"
+                "shaped)"
+            )
+        return None
+
+    def _horizon_capable(self) -> bool:
+        """Whether ``round_horizon > 1`` can fuse this session's rounds.
+        The base rule: the un-jitted FedAvg round program must exist for
+        the horizon builder to scan.  Sessions with their own fused run
+        loops (FedOBD) override this."""
+        return self._round_program_fn is not None
 
     def _leaf_spec(self, shape, name: str = "") -> P:
         """FSDP layout rule: shard a param leaf's leading dim over the
@@ -985,6 +999,35 @@ class SpmdFedAvgSession:
             client_rngs = self._fold_rngs(round_rng)
         return host_weights, weights, client_rngs, sel_idx
 
+    def _horizon_selection_rows(self, start_round: int, h: int):
+        """Host-precomputed per-round selection for one fused horizon of
+        ``h`` rounds starting at ``start_round``: ``(host [h, S] weight
+        matrix, device weight rows, device [h, S_pad] id rows or None)`` —
+        the scanned inputs every horizon-fused session (FedAvg family AND
+        the FedOBD phase programs) feeds its round scan."""
+        if self._selection_gather:
+            # host-precomputed [H, s_pad] id + weight matrices — the
+            # fused program gathers per scanned round
+            pairs = [
+                self._select_indices(r)
+                for r in range(start_round, start_round + h)
+            ]
+            host_weights = np.stack([w for _i, w in pairs])
+            idx_rows = put_sharded(
+                np.stack([i for i, _w in pairs]),
+                self._horizon_weight_sharding,
+            )
+        else:
+            idx_rows = None
+            host_weights = np.stack(
+                [
+                    self._select_weights(r)
+                    for r in range(start_round, start_round + h)
+                ]
+            )
+        weight_rows = put_sharded(host_weights, self._horizon_weight_sharding)
+        return host_weights, weight_rows, idx_rows
+
     @property
     def wasted_compute_fraction(self) -> float:
         """Fraction of the round program's client-slot compute whose
@@ -1155,28 +1198,8 @@ class SpmdFedAvgSession:
                     fn = self._horizon_fns[h] = self._build_horizon_fn(h)
                 start = _time.monotonic()
                 boundary = round_number + h - 1
-                if self._selection_gather:
-                    # host-precomputed [H, s_pad] id + weight matrices —
-                    # the fused program gathers per scanned round
-                    pairs = [
-                        self._select_indices(r)
-                        for r in range(round_number, round_number + h)
-                    ]
-                    host_weights = np.stack([w for _i, w in pairs])
-                    idx_rows = put_sharded(
-                        np.stack([i for i, _w in pairs]),
-                        self._horizon_weight_sharding,
-                    )
-                else:
-                    idx_rows = None
-                    host_weights = np.stack(
-                        [
-                            self._select_weights(r)
-                            for r in range(round_number, round_number + h)
-                        ]
-                    )
-                weight_rows = put_sharded(
-                    host_weights, self._horizon_weight_sharding
+                host_weights, weight_rows, idx_rows = (
+                    self._horizon_selection_rows(round_number, h)
                 )
                 # old params AND the rng carry are donated into the fused
                 # program — pending background fetches must finish first

@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..engine.engine import ComputeEngine
+from .mesh import use_mesh
 from .spmd import (
     SpmdFedAvgSession,
     scan_weighted_clients,
@@ -119,8 +120,9 @@ class SpmdExpertParallelSession(SpmdFedAvgSession):
 
         def fn(global_params, weights, rngs):
             # bare-PartitionSpec sharding constraints inside the MoE model
-            # resolve against the ambient mesh
-            with jax.sharding.set_mesh(mesh):
+            # resolve against the ambient mesh (version-compat helper: jax
+            # 0.4 has no jax.sharding.set_mesh)
+            with use_mesh(mesh):
                 return jitted(
                     global_params, weights, rngs, self._data,
                     self._val_data or {},

@@ -48,6 +48,19 @@ from .spmd import SpmdFedAvgSession, scan_local_epochs_carry, shard_map_compat
 from jax.sharding import PartitionSpec as P
 
 
+def _masked_slot_merge(keep, new_tree, old_tree):
+    """Per-slot ``where`` over ``[S, ...]`` state pytrees: slots with
+    ``keep[i]`` take the new leaf rows, the rest keep the old ones
+    (``keep`` broadcasts over each leaf's trailing dims)."""
+    return jax.tree.map(
+        lambda new, old: jnp.where(
+            keep.reshape(keep.shape + (1,) * (new.ndim - 1)), new, old
+        ),
+        new_tree,
+        old_tree,
+    )
+
+
 class SpmdFedOBDSession(SpmdFedAvgSession):
     """Two-phase FedOBD with block dropout + quantized transport, one
     program per phase.  ``codec`` selects the wire numerics: ``"nnadq"``
@@ -59,7 +72,96 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
     def __init__(self, *args, codec: str = "nnadq", **kwargs) -> None:
         self._phase2_fn = None
         self._codec = codec
+        #: un-jitted phase programs (phase_two -> fn) and their gather
+        #: twins — the horizon builder scans these, same trace as the
+        #: per-round path (populated by the base ``_wrap_phase_program``;
+        #: the ep/sp subclasses override it and stay per-round/dense)
+        self._phase_program_fns: dict[bool, object] = {}
+        self._gather_phase_program_fns: dict[bool, object] = {}
+        self._obd_horizon_fns: dict[tuple[bool, int], object] = {}
         super().__init__(*args, **kwargs)
+        # THE per-round client-key contract, shared with the threaded
+        # fed_obd worker (engine/executor.py::obd_aligned_round_stream):
+        # ``split(round_rng, client_slots(worker_number, make_mesh()))``,
+        # worker i at row i.  On jax 0.4's non-partitionable threefry,
+        # split PREFIXES depend on the split count, so every OBD layout
+        # must split to the SAME count and slice/take its rows — the
+        # whole-mesh-per-client subclasses (ep/sp, whose meshes have no
+        # clients axis and whose n_slots is just worker_number) override
+        # ``_stream_slots`` to this default-mesh count; deriving their
+        # keys from ``split(rng, n_slots)`` instead silently diverges
+        # from the client-axis (and threaded) trajectories wherever the
+        # model consumes training rng (the root cause behind the
+        # pre-existing expert-parallel OBD parity failure, visible once
+        # the set_mesh crash was fixed).
+        self._stream_slots = self.n_slots
+        # per-round client keys for the gather path: rows of the SAME
+        # full-population split the dense path uses, taken at the
+        # selected ids device-side
+        if self._selection_gather:
+            stream_slots = self._stream_slots
+            self._split_sel_rngs = jax.jit(
+                lambda round_rng, sel_idx: jnp.take(
+                    jax.random.split(round_rng, stream_slots), sel_idx, axis=0
+                ),
+                out_shardings=self._client_sharding,
+            )
+
+    @property
+    def _obd_selection_active(self) -> bool:
+        """Whether ``random_client_number`` leaves clients out of phase-1
+        rounds — the condition under which phase 1 carries (and merges)
+        the per-slot optimizer-state buffer on BOTH the dense and gather
+        paths, so the phase-2 seed is well-defined: each slot's state from
+        its last participation (fresh init if never selected)."""
+        return self._selected_per_round < self.config.worker_number
+
+    @property
+    def _phase1_carries_opt(self) -> bool:
+        """Phase-1 programs carry/merge the opt-state buffer only on the
+        client-axis session — the ep/sp subclasses keep the legacy
+        last-round-overwrites semantics their equivalence pins assume."""
+        return self._obd_selection_active and type(self) is SpmdFedOBDSession
+
+    def _selection_gather_unsupported_reason(self) -> str | None:
+        if type(self) is not SpmdFedOBDSession:
+            return (
+                f"{type(self).__name__} lays clients out as a"
+                " whole-mesh-per-client scan (own phase programs)"
+            )
+        return None
+
+    def _horizon_capable(self) -> bool:
+        # the client-axis OBD session fuses same-phase rounds; the
+        # expert-/sequence-parallel subclasses keep their own per-round
+        # programs and reject the knob loudly (base __init__ raises)
+        return type(self) is SpmdFedOBDSession
+
+    def _select_indices(self, round_number: int):
+        """Gather-path selection, OBD flavor: ascending selected worker
+        ids padded to ``s_pad`` with DISTINCT unselected slot ids at
+        weight 0 (the FedAvg base pads with id 0; the OBD phase programs
+        scatter per-slot optimizer states back through these ids, and a
+        duplicated index would make the scatter's write order — and the
+        carried state — unspecified)."""
+        from ..utils.selection import select_workers
+
+        selected = sorted(
+            select_workers(
+                self.config.seed,
+                round_number,
+                self.config.worker_number,
+                self.config.algorithm_kwargs.get("random_client_number"),
+            )
+        )
+        taken = set(selected)
+        padding = [i for i in range(self.n_slots) if i not in taken]
+        idx = np.asarray(
+            selected + padding[: self.s_pad - len(selected)], np.int32
+        )
+        weights = np.zeros(self.s_pad, np.float32)
+        weights[: len(selected)] = self._dataset_sizes[selected]
+        return idx, weights
 
     # ------------------------------------------------------------------
     def _build_round_fn(self):
@@ -186,7 +288,25 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         """Client-axis layout: slots over the ``clients`` mesh axis,
         chunk-scanned vmap inside ``shard_map``, psum aggregation.  The
         expert-parallel subclass overrides this with a whole-mesh-per-
-        client GSPMD layout (clients as a plain scan)."""
+        client GSPMD layout (clients as a plain scan).
+
+        Selection-aware additions (PR 3 machinery extended to the OBD
+        phase programs):
+
+        * under an ACTIVE ``random_client_number`` selection, phase 1
+          carries a per-slot ``[n_slots]`` optimizer-state buffer and
+          WHERE-MERGES each round's freshly trained states into it for the
+          selected slots only — a slot's phase-2 seed is the state from
+          its LAST PARTICIPATION (the threaded reference's semantics:
+          unselected workers do not train), and the dense and gather paths
+          agree on it bit-exactly;
+        * with ``selection_gather`` on, a gather twin trains only the
+          ``s_pad`` gathered slots: ``jnp.take`` on the stacked client
+          data along the slot axis before ``shard_map``, and the
+          optimizer-state merge becomes a scatter back into the carried
+          buffer (``_select_indices`` pads the id rows with DISTINCT
+          unselected slot ids so every slot is written at most once —
+          duplicate scatter indices have unspecified write order)."""
 
         def chunk_size(slots_local: int) -> int:
             mb = self.client_chunk
@@ -210,8 +330,12 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                     )(global_params, d, w, r, o)
 
                 if mb == slots_local:
+                    # phase 1 rebuilds optimizers per round: the carried
+                    # buffer (when present) is consumed by the merge below,
+                    # never by training
                     contributions, opt_out, metrics = run_slots(
-                        data, weights, rngs, opt_state_s
+                        data, weights, rngs,
+                        opt_state_s if phase_two else None,
                     )
                     local_sum = jax.tree.map(
                         lambda c: jnp.sum(c, axis=0), contributions
@@ -264,6 +388,13 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                         lambda x: x.reshape(slots_local, *x.shape[2:]),
                         opt_chunks,
                     )
+                if not phase_two and opt_state_s is not None:
+                    # selection-aware phase 1: the carried buffer keeps the
+                    # unselected slots' states (their last participation);
+                    # only selected slots take this round's trained states
+                    opt_out = _masked_slot_merge(
+                        weights > 0, opt_out, opt_state_s
+                    )
                 global_sum = jax.tree.map(
                     lambda s: jax.lax.psum(s, axis_name="clients"), local_sum
                 )
@@ -306,16 +437,154 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 out_specs=(P(), P(), P("clients"), P()),
             )(global_params, opt_state_s, data, weights, rngs, bcast_rng)
 
-        # data as an argument, not a closure constant (see spmd.py); phase 2
-        # also donates the carried optimizer states (same shape in and out)
-        donate = (0, 1) if phase_two else (0,)
+        # the horizon builder scans this same program — one trace, shared
+        # numerics with the per-round path
+        self._phase_program_fns[phase_two] = round_program
+
+        gather_jitted = None
+        if self._selection_gather:
+            client_sharding = self._client_sharding
+
+            def gather_phase_program(
+                global_params, opt_carry, weights, rngs, sel_idx, bcast_rng, data
+            ):
+                """The SAME phase program over a gathered ``[s_pad]`` slot
+                stack (device-side ``jnp.take`` — the full client stack
+                stays resident), with the per-slot optimizer states
+                gathered in (phase 2) / scattered back (both phases) so
+                the carried ``[n_slots]`` buffer matches the dense merge
+                bit-exactly."""
+
+                def take(x):
+                    return jax.lax.with_sharding_constraint(
+                        jnp.take(x, sel_idx, axis=0), client_sharding
+                    )
+
+                opt_sel = jax.tree.map(take, opt_carry)
+                exact, bcast, opt_out, metrics = round_program(
+                    global_params,
+                    opt_sel if phase_two else None,
+                    weights,
+                    rngs,
+                    bcast_rng,
+                    jax.tree.map(take, data),
+                )
+                # scatter-back: selected rows take their trained states,
+                # padding rows (weight 0, distinct unselected ids) write
+                # their own old state back — a no-op per slot
+                merged = _masked_slot_merge(weights > 0, opt_out, opt_sel)
+                new_carry = jax.tree.map(
+                    lambda c, m: jax.lax.with_sharding_constraint(
+                        c.at[sel_idx].set(m), client_sharding
+                    ),
+                    opt_carry,
+                    merged,
+                )
+                return exact, bcast, new_carry, metrics
+
+            self._gather_phase_program_fns[phase_two] = gather_phase_program
+            gather_jitted = jax.jit(
+                gather_phase_program, donate_argnums=(0, 1)
+            )
+
+        # data as an argument, not a closure constant (see spmd.py); the
+        # carried optimizer states (phase 2 always, phase 1 under an
+        # active selection) are donated alongside the params (same shape
+        # in and out)
+        donate = (0, 1) if (phase_two or self._phase1_carries_opt) else (0,)
         jitted = jax.jit(round_program, donate_argnums=donate)
 
-        def fn(global_params, weights, rngs, bcast_rng, opt_state_s=None):
+        def fn(
+            global_params, weights, rngs, bcast_rng, opt_state_s=None,
+            sel_idx=None,
+        ):
+            if sel_idx is not None:
+                return gather_jitted(
+                    global_params, opt_state_s, weights, rngs, sel_idx,
+                    bcast_rng, self._data,
+                )
             return jitted(
                 global_params, opt_state_s, weights, rngs, bcast_rng, self._data
             )
 
+        fn._jitted = jitted
+        fn._jitted_gather = gather_jitted
+        return fn
+
+    # ------------------------------------------------------------------
+    def _build_obd_horizon_fn(self, phase_two: bool, horizon: int):
+        """``horizon`` consecutive SAME-phase rounds as ONE jitted,
+        donated ``lax.scan``: the carry is (broadcast params, per-slot
+        optimizer states, last exact aggregate, rng chain).  Each step
+        advances the chain exactly like the host loop (``split(rng, 3)``
+        per aggregate — H=1 and H≥4 trajectories are bit-identical),
+        derives the per-slot client keys from the SAME full-population
+        split, runs the phase program the per-round path jits (dense or
+        gather), and evaluates the EXACT aggregate on the device-resident
+        test batches — stacked ``[H, ...]`` metrics come back in one host
+        sync.  The broadcast (codec-distorted) global feeds the next
+        scanned round while the exact aggregate rides the carry so the
+        horizon boundary can checkpoint it, matching the per-round loop's
+        bookkeeping."""
+        engine = self.engine
+        n_slots = self.n_slots
+        stream_slots = self._stream_slots
+        program = self._phase_program_fns[phase_two]
+        gather_program = self._gather_phase_program_fns.get(phase_two)
+        use_gather = self._selection_gather and not phase_two
+        carry_opt = phase_two or self._phase1_carries_opt
+        with_confusion = bool(self.config.use_slow_performance_metrics)
+
+        def horizon_program(
+            global_params, opt_state_s, rng, weight_rows, idx_rows, data,
+            eval_batches,
+        ):
+            def body(carry, xs):
+                params, opt_s, _exact, rng = carry
+                rng, round_rng, bcast_rng = jax.random.split(rng, 3)
+                keys = jax.random.split(round_rng, stream_slots)[:n_slots]
+                if use_gather:
+                    weights, sel_idx = xs
+                    client_rngs = jnp.take(keys, sel_idx, axis=0)
+                    exact, bcast, opt_s, metrics = gather_program(
+                        params, opt_s, weights, client_rngs, sel_idx,
+                        bcast_rng, data,
+                    )
+                else:
+                    weights = xs
+                    exact, bcast, opt_s, metrics = program(
+                        params,
+                        opt_s if carry_opt else None,
+                        weights,
+                        keys,
+                        bcast_rng,
+                        data,
+                    )
+                outs = (metrics, engine.eval_fn(exact, eval_batches))
+                if with_confusion:
+                    outs = outs + (engine.confusion_fn(exact, eval_batches),)
+                return (bcast, opt_s, exact, rng), outs
+
+            exact0 = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, p.dtype), global_params
+            )
+            xs = (weight_rows, idx_rows) if use_gather else weight_rows
+            carry, outs = jax.lax.scan(
+                body, (global_params, opt_state_s, exact0, rng), xs,
+                length=horizon,
+            )
+            bcast, opt_state_s, exact, rng = carry
+            return (exact, bcast, opt_state_s, rng), outs
+
+        jitted = jax.jit(horizon_program, donate_argnums=(0, 1, 2))
+
+        def fn(global_params, opt_state_s, rng, weight_rows, idx_rows=None):
+            return jitted(
+                global_params, opt_state_s, rng, weight_rows, idx_rows,
+                self._data, self._ensure_eval_batches(),
+            )
+
+        fn._jitted = jitted
         return fn
 
     # ------------------------------------------------------------------
@@ -417,10 +686,18 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             (s.get("test_accuracy", 0.0) for s in self._stat.values()),
             default=0.0,
         )
-        # resume landing in phase 2 (or exactly at the switch) continues the
-        # optimizer states saved with the last kept aggregate
+        # resume landing in phase 2 (or exactly at the switch) continues
+        # the optimizer states saved with the last kept aggregate; under
+        # an active selection the phase-1 carry (each slot's state from
+        # its last participation) is saved/restored the same way
         self._resumed_opt_state = None
-        if kept and driver.phase is not None and not driver.phase.block_dropout:
+        if (
+            kept
+            and driver.phase is not None
+            and (
+                not driver.phase.block_dropout or self._phase1_carries_opt
+            )
+        ):
             self._resumed_opt_state = self._load_opt_state(
                 resume_dir, kept_keys[-1]
             )
@@ -440,7 +717,21 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
     def run(self) -> dict:
         """Drive the phases off the SAME :class:`ObdRoundDriver` the
         threaded server uses (``method/fed_obd/driver.py``) — the round
-        structure has exactly one definition across executors."""
+        structure has exactly one definition across executors.
+
+        With ``algorithm_kwargs.round_horizon`` > 1 (client-axis session
+        only), consecutive SAME-phase rounds run as one fused dispatch:
+        the horizon is clamped to the phase's remaining budget so every
+        phase switch lands on a horizon boundary, checkpoints and
+        opt-state saves land on boundaries (the exact aggregate rides the
+        fused carry), and the rng chain advances in-program — the
+        aggregate chain is bit-identical to H=1.  ``early_stop`` needs
+        every round's test metric on host before the next round may run,
+        so it degrades fusion to per-round, loudly."""
+        from ..engine.engine import (
+            slow_metrics_from_confusion,
+            stacked_round_metrics,
+        )
         from ..method.fed_obd.driver import ObdRoundDriver
 
         config = self.config
@@ -458,43 +749,86 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         rng = jax.random.PRNGKey(config.seed)
         for _ in range(resumed_aggs):  # keep the rng stream aligned
             rng, _r, _b = jax.random.split(rng, 3)
+        fused = self.round_horizon > 1
+        if fused and driver.early_stop:
+            get_logger().warning(
+                "round_horizon=%d with early_stop: the plateau decision"
+                " needs each round's test metric on host before the next"
+                " round may run — running per-round (H=1)",
+                self.round_horizon,
+            )
+            fused = False
+        if fused:
+            # replicate the chain carry up front: the fused program
+            # returns it replicated, and a sharding mismatch on the first
+            # chunk would retrace per run (see _run_horizon)
+            rng = jax.device_put(rng, self._replicated)
 
         # per-slot optimizer states, carried round-to-round (restored from
         # opt_state.npz when the resume landed on the matching aggregate)
         opt_state_s = getattr(self, "_resumed_opt_state", None)
         if opt_state_s is not None:
-            # same aliasing hazard as train_params: phase 2 DONATES these
-            # states, so the restored numpy leaves need XLA-owned buffers
+            # same aliasing hazard as train_params: the phase programs
+            # DONATE these states, so the restored numpy leaves need
+            # XLA-owned buffers
             opt_state_s = jax.tree.map(
                 jnp.copy, put_sharded(opt_state_s, self._client_sharding)
             )
 
-        def step(fn, params, weights, round_number, phase_label, use_opt):
+        def fresh_opt_states():
+            return jax.jit(
+                jax.vmap(
+                    self.engine.optimizer.init,
+                    in_axes=None,
+                    axis_size=self.n_slots,
+                )
+            )(train_params)
+
+        def step(fn, params, weights, round_number, phase_label, use_opt,
+                 sel_host=None):
             nonlocal rng, opt_state_s
             rng, round_rng, bcast_rng = jax.random.split(rng, 3)
-            client_rngs = put_sharded(
-                jax.random.split(round_rng, self.n_slots), self._client_sharding
-            )
+            if sel_host is not None:
+                sel_idx = put_sharded(sel_host, self._client_sharding)
+                client_rngs = self._split_sel_rngs(round_rng, sel_idx)
+            else:
+                sel_idx = None
+                # split to the shared stream count, slots at the leading
+                # rows (identity slice on the client-axis session; the
+                # ep/sp layouts take their worker_number rows of the SAME
+                # default-mesh split — see _stream_slots)
+                client_rngs = put_sharded(
+                    jax.random.split(round_rng, self._stream_slots)[
+                        : self.n_slots
+                    ],
+                    self._client_sharding,
+                )
             weights = put_sharded(weights, self._client_sharding)
             if use_opt:
-                # opt_state_s is DONATED into the phase-2 program — a
-                # queued opt-state checkpoint fetch must win the race with
-                # XLA reusing those buffers.  Phase 1 donates only the
-                # never-saved broadcast params: no barrier needed there
+                # the opt-state carry is DONATED into the phase program —
+                # a queued opt-state checkpoint fetch must win the race
+                # with XLA reusing those buffers.  A carry-less phase 1
+                # donates only the never-saved broadcast params: no
+                # barrier needed there
                 self._ckpt.barrier()
             # distinct phase labels: phase 2 compiles its own program
             # mid-run and must get its own compile grace
             exact, bcast, opt_state_s, metrics = self._watchdog.call(
-                lambda: fn(
-                    params,
-                    weights,
-                    client_rngs,
-                    bcast_rng,
-                    opt_state_s if use_opt else None,
+                lambda: (
+                    fn(
+                        params, weights, client_rngs, bcast_rng,
+                        opt_state_s if use_opt else None, sel_idx,
+                    )
+                    if sel_idx is not None
+                    else fn(
+                        params, weights, client_rngs, bcast_rng,
+                        opt_state_s if use_opt else None,
+                    )
                 ),
                 phase=phase_label,
                 round_number=round_number,
             )
+            self.dispatch_count += 1
             self._opt_state_s = opt_state_s  # observable continuation state
             return exact, bcast, {
                 k: float(np.asarray(v)) for k, v in metrics.items()
@@ -504,53 +838,125 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         with self._ckpt:  # flush async round checkpoints at exit
             while not driver.finished:
                 spec = driver.phase
-                if spec.block_dropout:
-                    fn = self._phase1_fn
-                    tick += 1
-                    weights = self._select_weights(tick)
-                    stat_key = tick
+                phase_two = not spec.block_dropout
+                phase_label = "round-phase2" if phase_two else "round"
+                if phase_two and self._phase2_fn is None:
+                    self._phase2_fn = self._build_phase_fn(phase_two=True)
+                carry_opt = phase_two or self._phase1_carries_opt
+                h = (
+                    max(1, min(self.round_horizon, driver.remaining))
+                    if fused
+                    else 1
+                )
+                if (carry_opt or h > 1) and opt_state_s is None:
+                    # fresh per-slot optimizers: phase 2 with no phase-1
+                    # rounds before it, the first carrying phase-1 round
+                    # (never-selected slots keep these init states as
+                    # their phase-2 seed), or a fused phase-1 scan — its
+                    # carry needs a structure-stable opt buffer even when
+                    # the rounds themselves rebuild optimizers
+                    opt_state_s = fresh_opt_states()
+                if phase_two:
+                    base_key = max(self._stat) if self._stat else 0
+                    keys = [base_key + i + 1 for i in range(h)]
                 else:
-                    if self._phase2_fn is None:
-                        self._phase2_fn = self._build_phase_fn(phase_two=True)
-                    if opt_state_s is None:
-                        # phase 2 with no phase-1 rounds before it: fresh
-                        # per-slot optimizers (nothing to continue from)
-                        opt_state_s = jax.jit(
-                            jax.vmap(
-                                self.engine.optimizer.init,
-                                in_axes=None,
-                                axis_size=self.n_slots,
+                    keys = [tick + i + 1 for i in range(h)]
+                    tick += h
+                if h == 1:
+                    key = keys[0]
+                    sel_host = None
+                    if phase_two:
+                        fn = self._phase2_fn
+                        weights = self._all_weights()
+                    else:
+                        fn = self._phase1_fn
+                        if self._selection_gather:
+                            sel_host, weights = self._select_indices(key)
+                        else:
+                            weights = self._select_weights(key)
+                    exact, train_params, met = step(
+                        fn, train_params, weights, key, phase_label,
+                        use_opt=carry_opt, sel_host=sel_host,
+                    )
+                    metric = self._watchdog.call(
+                        lambda: self._evaluate(exact),
+                        phase="eval",
+                        round_number=key,
+                    )  # phase 2: check_acc semantics
+                    self.dispatch_count += 1
+                    self.host_sync_count += 1
+                    self.rounds_run += 1
+                    self._record_obd(
+                        key, metric, met, exact, save_dir, spec.name
+                    )
+                    improved = True
+                    if driver.early_stop:
+                        improved = self._has_improvement()
+                    decision = driver.after_aggregate(
+                        improved=improved, check_acc=spec.check_acc
+                    )
+                else:
+                    fnh = self._obd_horizon_fns.get((phase_two, h))
+                    if fnh is None:
+                        fnh = self._obd_horizon_fns[(phase_two, h)] = (
+                            self._build_obd_horizon_fn(phase_two, h)
+                        )
+                    if phase_two:
+                        idx_rows = None
+                        weight_rows = put_sharded(
+                            np.tile(self._all_weights(), (h, 1)),
+                            self._horizon_weight_sharding,
+                        )
+                    else:
+                        _hw, weight_rows, idx_rows = (
+                            self._horizon_selection_rows(keys[0], h)
+                        )
+                    # params, the opt carry AND the rng chain are donated
+                    # into the fused program — pending background fetches
+                    # must finish first
+                    self._ckpt.barrier()
+                    (exact, train_params, opt_state_s, rng), outs = (
+                        self._watchdog.call(
+                            lambda gp=train_params, o=opt_state_s, r=rng,
+                            w=weight_rows, i=idx_rows: fnh(gp, o, r, w, i),
+                            phase=phase_label,
+                            round_number=keys[-1],
+                        )
+                    )
+                    self._opt_state_s = opt_state_s
+                    self.dispatch_count += 1
+                    # ONE host sync per horizon: the stacked metric fetch
+                    train_mets = {
+                        k: np.asarray(v) for k, v in outs[0].items()
+                    }
+                    per_round = stacked_round_metrics(outs[1])
+                    confusion = np.asarray(outs[2]) if len(outs) > 2 else None
+                    self.host_sync_count += 1
+                    self.rounds_run += h
+                    for i, key in enumerate(keys):
+                        metric = per_round[i]
+                        if confusion is not None:
+                            metric.update(
+                                slow_metrics_from_confusion(confusion[i])
                             )
-                        )(train_params)
-                    fn = self._phase2_fn
-                    weights = self._all_weights()
-                    stat_key = max(self._stat) + 1 if self._stat else 1
-                exact, train_params, met = step(
-                    fn,
-                    train_params,
-                    weights,
-                    stat_key,
-                    "round" if spec.block_dropout else "round-phase2",
-                    use_opt=not spec.block_dropout,
-                )
-                metric = self._watchdog.call(
-                    lambda: self._evaluate(exact),
-                    phase="eval",
-                    round_number=stat_key,
-                )  # phase 2: check_acc semantics
-                self._record_obd(
-                    stat_key, metric, met, exact, save_dir, spec.name
-                )
-                improved = True
-                if driver.early_stop:
-                    improved = self._has_improvement()
-                decision = driver.after_aggregate(
-                    improved=improved, check_acc=spec.check_acc
-                )
-                if decision.annotations or not spec.block_dropout:
-                    # the states entering phase 2 (at the switch) and after
-                    # every phase-2 epoch are what a resume needs
-                    self._save_opt_state(stat_key)
+                        met = {k: float(v[i]) for k, v in train_mets.items()}
+                        # only the boundary's exact aggregate materialized
+                        self._record_obd(
+                            key, metric, met,
+                            exact if key == keys[-1] else None,
+                            save_dir, spec.name,
+                        )
+                        # h never exceeds the phase budget, so only the
+                        # final tick can switch phases / end training
+                        decision = driver.after_aggregate(
+                            improved=True, check_acc=spec.check_acc
+                        )
+                if decision.annotations or carry_opt:
+                    # the states entering phase 2 (at the switch), after
+                    # every phase-2 aggregate, and — under an active
+                    # selection — after every carrying phase-1 boundary
+                    # are what a resume needs
+                    self._save_opt_state(keys[-1])
                 if decision.annotations:
                     get_logger().info(
                         "phase switch -> %s",
@@ -565,19 +971,23 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         self, stat_key, metric, round_metrics, exact, save_dir, phase_name=""
     ):
         mb = 1 / 8e6
-        self._record(
-            stat_key,
-            metric,
-            exact,
-            save_dir,
-            extra={
-                "received_mb": round_metrics["upload_bits"] * mb,
-                "sent_mb": round_metrics["bcast_bits"] * mb,
-                # which phase produced this aggregate — lets a resume replay
-                # the driver's transitions from the record alone
-                "phase": phase_name,
-            },
-        )
+        extra = {
+            "received_mb": round_metrics["upload_bits"] * mb,
+            "sent_mb": round_metrics["bcast_bits"] * mb,
+            # which phase produced this aggregate — lets a resume replay
+            # the driver's transitions from the record alone
+            "phase": phase_name,
+        }
+        if exact is None:
+            # mid-horizon round under fusion: the exact aggregate was
+            # never materialized — stat row only; checkpoints land on
+            # horizon boundaries (the FedAvg fused loop's contract, and
+            # what resume expects: the latest round with BOTH a
+            # checkpoint and a record row)
+            self._note_round(stat_key, metric, save_dir, extra=extra)
+            self._max_acc = max(self._max_acc, metric["accuracy"])
+        else:
+            self._record(stat_key, metric, exact, save_dir, extra=extra)
         if round_metrics["upload_bits"]:
             # wire bits / full-precision full-model bits per selected client
             # — the combined dropout × quantization saving (analyze_log

@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..engine.engine import ComputeEngine
+from .mesh import use_mesh
 from .spmd_obd import SpmdFedOBDSession
 
 
@@ -137,6 +138,14 @@ class SpmdFedOBDExpertParallelSession(SpmdFedOBDSession):
             config, dataset_collection, model_ctx, engine, practitioners,
             mesh=ep_mesh, codec=codec,
         )
+        # the ("ep",) mesh has no clients axis, so n_slots is bare
+        # worker_number — but the per-round client-key contract splits to
+        # the DEFAULT client-axis slot count (split prefixes depend on
+        # the count on non-partitionable threefry; see
+        # SpmdFedOBDSession._stream_slots)
+        from .mesh import client_slots, make_mesh
+
+        self._stream_slots = client_slots(config.worker_number, make_mesh())
         if not any(spec != P() for spec in self._param_specs.values()):
             raise ValueError(
                 f"expert_parallel set but model {config.model_name!r} has no "
@@ -175,8 +184,9 @@ class SpmdFedOBDExpertParallelSession(SpmdFedOBDSession):
 
         def fn(global_params, weights, rngs, bcast_rng, opt_state_s=None):
             # bare-PartitionSpec constraints inside the MoE model resolve
-            # against the ambient mesh
-            with jax.sharding.set_mesh(mesh):
+            # against the ambient mesh (version-compat helper: jax 0.4 has
+            # no jax.sharding.set_mesh)
+            with use_mesh(mesh):
                 return jitted(
                     global_params, opt_state_s, weights, rngs, bcast_rng,
                     self._data,

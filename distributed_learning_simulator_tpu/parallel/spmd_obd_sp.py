@@ -70,6 +70,12 @@ class SpmdFedOBDSequenceParallelSession(
             config, dataset_collection, model_ctx, engine, practitioners,
             mesh=sp_mesh, codec=codec,
         )
+        # same client-key contract as the expert-parallel layout: split to
+        # the default client-axis slot count, take the worker rows (see
+        # SpmdFedOBDSession._stream_slots)
+        from .mesh import client_slots, make_mesh
+
+        self._stream_slots = client_slots(config.worker_number, make_mesh())
         # re-place the sequence-bearing leaves sharded over "sp" (the base
         # placed the stacked client data replicated — no clients axis)
         self._data = {

@@ -50,6 +50,29 @@ def test_bench_main_prints_one_json_line(monkeypatch):
     )
     monkeypatch.setattr(
         bench,
+        "measure_obd_horizon",
+        lambda: {
+            "model": "densenet40/CIFAR10",
+            "horizon": bench.OBD_HORIZON,
+            "dense_h1": {
+                "rounds_per_sec": 0.2,
+                "dispatches_per_round": 2.0,
+                "host_sync_points": 1.0,
+                "selection_path": "dense",
+                "wasted_compute_fraction": 0.5,
+            },
+            f"gather_h{bench.OBD_HORIZON}": {
+                "rounds_per_sec": 0.5,
+                "dispatches_per_round": 1.0 / bench.OBD_HORIZON,
+                "host_sync_points": 1.0 / bench.OBD_HORIZON,
+                "selection_path": "gather",
+                "wasted_compute_fraction": 0.0,
+            },
+            "speedup": 2.5,
+        },
+    )
+    monkeypatch.setattr(
+        bench,
         "measure_selection_gather",
         lambda: {
             "workers": bench.SEL_WORKERS,
@@ -94,6 +117,8 @@ def test_bench_main_prints_one_json_line(monkeypatch):
         "selection_path",
         "wasted_compute_fraction",
         "selection",
+        "obd_fusion_path",
+        "obd_fusion",
     ):
         assert field in payload, field
     assert payload["metric"] == "fedavg_cifar10_100clients_rounds_per_sec"
@@ -112,6 +137,14 @@ def test_bench_main_prints_one_json_line(monkeypatch):
     assert payload["dispatches_per_round"] == 1.0 / bench.HZ_HORIZON
     assert payload["host_sync_points"] == 1.0 / bench.HZ_HORIZON
     assert "h1" in payload["dispatch_budget"]
+    # FedOBD fusion: the top-level path summary mirrors the fused arm
+    # (gather + < 1 dispatch/round), the full A/B rides under obd_fusion
+    obd = payload["obd_fusion_path"]
+    assert obd["selection_path"] == "gather"
+    assert obd["dispatches_per_round"] == 1.0 / bench.OBD_HORIZON
+    assert obd["dispatches_per_round"] < 1.0
+    assert obd["speedup"] == 2.5
+    assert "dense_h1" in payload["obd_fusion"]
 
 
 def test_bench_main_survives_measurement_failures(monkeypatch):
@@ -129,6 +162,7 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     monkeypatch.setattr(bench, "measure_large_scale", boom)
     monkeypatch.setattr(bench, "measure_aggregation", boom)
     monkeypatch.setattr(bench, "measure_round_horizon", boom)
+    monkeypatch.setattr(bench, "measure_obd_horizon", boom)
     monkeypatch.setattr(bench, "measure_selection_gather", boom)
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
@@ -150,3 +184,7 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     assert "error" in payload["selection"]
     assert payload["selection_path"] == "gather"
     assert payload["wasted_compute_fraction"] == 0.0
+    # OBD fusion degrades the same way: error marker + default path
+    assert "error" in payload["obd_fusion"]
+    assert payload["obd_fusion_path"]["selection_path"] == "gather"
+    assert payload["obd_fusion_path"]["dispatches_per_round"] == 0.0

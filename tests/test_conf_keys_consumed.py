@@ -48,6 +48,10 @@ CONSUMERS: dict[tuple[str, str], list[str]] = {
         "utils/selection.py",
     ],
     ("algorithm_kwargs", "second_phase_epoch"): ["method/fed_obd/driver.py"],
+    ("algorithm_kwargs", "round_horizon"): [
+        "parallel/spmd.py",
+        "parallel/spmd_obd.py",
+    ],
     ("algorithm_kwargs", "share_feature"): [
         "worker/graph_worker.py",
         "parallel/spmd_gnn.py",

@@ -1,0 +1,84 @@
+"""Donation-aliasing lint (``tools/donation_lint.py``) pinned in tier-1.
+
+The bug class: ``jax.device_put`` of an aligned host numpy array returns
+a zero-copy VIEW on the cpu backend; if that result flows into a jitted
+program's DONATED argument, XLA reuses memory python still owns — the
+``_place_params`` NaN/segfault PR 2 fixed.  The lint enumerates every
+``jax.device_put`` call not wrapped in an intervening ``jnp.copy``; this
+test pins the result against the audited allowlist below.  A NEW
+un-audited ``device_put`` fails here until someone audits it (add it
+with a justification comment) — and a removed site must be cleaned up.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from donation_lint import find_unwrapped_device_put  # noqa: E402
+
+#: every audited-good ``jax.device_put`` site, with why it cannot feed a
+#: donated argument an aliased host buffer
+KNOWN_GOOD = {
+    # eval batches placed for the threaded executor's eval loop — read
+    # by eval_fn, never a donated argument
+    "distributed_learning_simulator_tpu/engine/executor.py::_eval_batches",
+    # THE generic placement primitive; donating callers are responsible
+    # for the on-device copy (_place_params / the OBD resume paths do
+    # jax.tree.map(jnp.copy, put_sharded(...)) — the pattern this lint
+    # enforces at new call sites)
+    "distributed_learning_simulator_tpu/parallel/mesh.py::put_sharded",
+    # reshard-to-replicated of PROGRAM OUTPUTS for the async checkpoint
+    # writer — device-owned arrays, never aliased host memory, and the
+    # result is fetched, not fed back into a program
+    "distributed_learning_simulator_tpu/parallel/spmd.py::_checkpointable",
+    "distributed_learning_simulator_tpu/parallel/spmd_obd.py::_save_opt_state",
+    "distributed_learning_simulator_tpu/parallel/spmd_sparse.py::_record",
+    # the horizon rng carries ARE donated, but their sources are jax
+    # device arrays (PRNGKey / prior program outputs) — device_put of a
+    # device array never aliases the python heap
+    "distributed_learning_simulator_tpu/parallel/spmd.py::_run_horizon",
+    "distributed_learning_simulator_tpu/parallel/spmd_obd.py::run",
+    # stacked client data re-placed with sequence sharding — round
+    # programs take data as a non-donated argument
+    "distributed_learning_simulator_tpu/parallel/spmd_obd_sp.py::__init__",
+    "distributed_learning_simulator_tpu/parallel/spmd_sp.py::__init__",
+    # single-device eval twin: params/batches placed for a non-donated
+    # eval program
+    "distributed_learning_simulator_tpu/parallel/spmd_sp.py::_evaluate",
+}
+
+
+def test_device_put_sites_are_audited():
+    pkg = os.path.join(REPO, "distributed_learning_simulator_tpu")
+    findings = set(find_unwrapped_device_put(pkg))
+    new = findings - KNOWN_GOOD
+    stale = KNOWN_GOOD - findings
+    assert not new, (
+        "un-audited jax.device_put call sites (audit for donation"
+        f" aliasing, then add to KNOWN_GOOD): {sorted(new)}"
+    )
+    assert not stale, f"stale KNOWN_GOOD entries to remove: {sorted(stale)}"
+
+
+def test_lint_flags_unwrapped_and_accepts_copied(tmp_path):
+    """The lint's own contract: a bare device_put is flagged, a
+    jnp.copy/tree.map(jnp.copy, ...) wrap is not."""
+    pkg = tmp_path / "fakepkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "import jax\n"
+        "def place(x, s):\n"
+        "    return jax.device_put(x, s)\n"
+    )
+    (pkg / "good.py").write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def place(x, s):\n"
+        "    return jnp.copy(jax.device_put(x, s))\n"
+        "def place_tree(x, s):\n"
+        "    return jax.tree.map(jnp.copy, jax.device_put(x, s))\n"
+    )
+    findings = find_unwrapped_device_put(str(pkg))
+    assert findings == ["fakepkg/bad.py::place"]

@@ -130,7 +130,9 @@ def test_expert_parallel_matches_unsharded():
         )
 
     sharded_vars = jax.tree_util.tree_map_with_path(shard_leaf, variables)
-    with jax.sharding.set_mesh(mesh):
+    from distributed_learning_simulator_tpu.parallel.mesh import use_mesh
+
+    with use_mesh(mesh):
         out = jax.jit(lambda v, t: ep.apply(v, t))(sharded_vars, tokens)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5

@@ -204,25 +204,209 @@ def test_record_flush_cadence_and_atomicity(tmp_session_dir):
         assert "test_accuracy" in row and "round_seconds" in row
 
 
-def test_unsupported_session_rejects_round_horizon(tmp_session_dir):
-    """Sessions with their own round programs (OBD here) must refuse the
-    knob loudly instead of silently ignoring it."""
+def _obd_config(save_dir, horizon=1, rounds=4, phase2=2, k=None, gather=None,
+                workers=8, **overrides):
+    algorithm_kwargs = {
+        "dropout_rate": 0.3,
+        "second_phase_epoch": phase2,
+        "early_stop": False,
+        **overrides.pop("algorithm_kwargs", {}),
+    }
+    if horizon != 1:
+        algorithm_kwargs["round_horizon"] = horizon
+    if k is not None:
+        algorithm_kwargs["random_client_number"] = k
+    if gather is not None:
+        algorithm_kwargs["selection_gather"] = gather
+    config = fed_avg_config(
+        distributed_algorithm="fed_obd",
+        executor="spmd",
+        worker_number=workers,
+        round=rounds,
+        epoch=1,
+        batch_size=16,
+        dataset_kwargs={"train_size": 128, "val_size": 16, "test_size": 32},
+        algorithm_kwargs=algorithm_kwargs,
+        endpoint_kwargs={
+            "server": {"weight": 0.01},
+            "worker": {"weight": 0.01},
+        },
+        save_dir=save_dir,
+        **overrides,
+    )
+    config.load_config_and_process()
+    return config
+
+
+def _obd_rows(result):
+    """(accuracy, loss, wire bytes) per aggregate — the full stat surface
+    both OBD run loops must agree on."""
+    return {
+        key: (
+            row["test_accuracy"],
+            row["test_loss"],
+            row["received_mb"],
+            row["sent_mb"],
+            row["phase"],
+        )
+        for key, row in result["performance"].items()
+        if key > 0
+    }
+
+
+def test_obd_h1_vs_h4_bit_exact_across_phase_boundary(tmp_session_dir):
+    """The FedOBD acceptance pin: H=4 fuses the 4 phase-1 rounds into one
+    dispatch and the 2 phase-2 epochs into another, clamping at the phase
+    boundary — every aggregate's test metrics, wire accounting, phase tag
+    and the final exact aggregate must equal the per-round loop
+    bit-exactly (the in-program rng chain replays split(rng, 3) per
+    aggregate, and the phase-2 optimizer continuation rides the fused
+    carry)."""
+    r1 = train(_obd_config("obd_h1"))
+    r4 = train(_obd_config("obd_h4", horizon=4))
+    assert _obd_rows(r1) == _obd_rows(r4)
+    p1 = _final_params("obd_h1", 6)
+    p4 = _final_params("obd_h4", 6)
+    assert p1.keys() == p4.keys()
+    for key in p1:
+        np.testing.assert_array_equal(p1[key], p4[key], err_msg=key)
+    # the fused run checkpoints on horizon/phase boundaries only
+    assert sorted(os.listdir(os.path.join("obd_h4", "aggregated_model"))) == [
+        "opt_state.npz",
+        "round_4.npz",
+        "round_6.npz",
+    ]
+
+
+def test_obd_fused_selection_gather_and_dispatch_budget(tmp_session_dir):
+    """gather × fusion composes for OBD: with random_client_number active
+    the fused phase-1 scan gathers each round's cohort from the [H, s_pad]
+    id matrix; trajectories stay bit-exact vs the dense per-round loop,
+    through ONE compiled horizon program per (phase, h) — and the session's
+    dispatch budget drops below one dispatch per round."""
+    from distributed_learning_simulator_tpu.training import _build_task
+
+    dense = train(_obd_config("obd_sd", k=5, gather=False))
+    config = _obd_config("obd_sf", horizon=4, k=5, gather=True)
+    ctx = _build_task(config)
+    from distributed_learning_simulator_tpu.parallel.spmd_obd import (
+        SpmdFedOBDSession,
+    )
+
+    session = SpmdFedOBDSession(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+    assert session._selection_gather
+    fused = session.run()
+    assert _obd_rows(dense) == _obd_rows(fused)
+    # 6 aggregates (4 phase-1 + 2 phase-2) in 2 fused dispatches
+    assert session.rounds_run == 6
+    assert session.dispatch_count == 2
+    assert session.host_sync_count == 2
+    assert session.dispatches_per_round < 1
+    # one compiled horizon program per (phase, clamped h), each traced once
+    assert sorted(session._obd_horizon_fns) == [(False, 4), (True, 2)]
+    for fn in session._obd_horizon_fns.values():
+        assert fn._jitted._cache_size() == 1
+
+
+def test_obd_resume_from_horizon_boundary_rejoins_h1_chain(tmp_session_dir):
+    """A fused OBD run checkpoints on horizon boundaries with the
+    per-slot optimizer states tagged to the boundary aggregate; resuming
+    it (at H=1, with a larger phase-2 budget) must be indistinguishable
+    from resuming a pure H=1 run from the same aggregate — the replayed
+    rows, the re-joined rng chain, the restored phase-2 momentum and the
+    continued trajectory all bit-exact.  (Both resumes share the
+    documented deviation of restarting from the EXACT aggregate rather
+    than the quantized broadcast, so they are compared against each
+    other, not an uninterrupted run.)"""
+    from distributed_learning_simulator_tpu.parallel.spmd_obd import (
+        SpmdFedOBDSession,
+    )
+    from distributed_learning_simulator_tpu.training import _build_task
+
+    h1 = train(_obd_config("obd_cut_h1", phase2=2))
+    fused = train(_obd_config("obd_cut_fused", horizon=2, phase2=2))
+    assert _obd_rows(h1) == _obd_rows(fused)
+    resumed_h1 = train(
+        _obd_config(
+            "obd_res_h1",
+            phase2=4,
+            algorithm_kwargs={"resume_dir": "obd_cut_h1"},
+        )
+    )
+    config = _obd_config(
+        "obd_res_fused",
+        phase2=4,
+        algorithm_kwargs={"resume_dir": "obd_cut_fused"},
+    )
+    ctx = _build_task(config)
+    session = SpmdFedOBDSession(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+    resumed_fused = session.run()
+    # the fused run's boundary opt states were saved and restored — the
+    # phase-2 continuation really continues momentum, it does not re-init
+    assert session._resumed_opt_state is not None
+    assert _obd_rows(resumed_h1) == _obd_rows(resumed_fused)
+    pa = _final_params("obd_res_h1", 8)
+    pb = _final_params("obd_res_fused", 8)
+    for key in pa:
+        np.testing.assert_array_equal(pa[key], pb[key], err_msg=key)
+
+
+def test_obd_expert_parallel_rejects_round_horizon(tmp_session_dir):
+    """The expert-parallel FedOBD subclass keeps its own per-round phase
+    programs — round_horizon must be refused loudly, not silently ignored
+    (the client-axis session now fuses instead of rejecting)."""
     import pytest
 
-    config = _config(
-        rounds=2,
-        horizon=2,
-        save_dir="obd",
+    from distributed_learning_simulator_tpu.config import (
+        DistributedTrainingConfig,
+    )
+
+    config = DistributedTrainingConfig(
+        dataset_name="imdb",
+        model_name="MoETransformerClassificationModel",
         distributed_algorithm="fed_obd",
+        executor="spmd",
+        worker_number=2,
+        batch_size=4,
+        round=2,
+        epoch=1,
+        learning_rate=0.05,
         algorithm_kwargs={
-            "round_horizon": 2,
             "dropout_rate": 0.3,
             "second_phase_epoch": 1,
+            "round_horizon": 2,
         },
         endpoint_kwargs={
             "server": {"weight": 0.01},
             "worker": {"weight": 0.01},
         },
+        dataset_kwargs={
+            "train_size": 16,
+            "val_size": 4,
+            "test_size": 8,
+            "max_len": 16,
+        },
+        model_kwargs={
+            "d_model": 16,
+            "nhead": 2,
+            "num_encoder_layer": 2,
+            "n_experts": 4,
+            "max_len": 16,
+            "expert_parallel": 4,
+        },
     )
+    config.load_config_and_process()
     with pytest.raises(ValueError, match="round_horizon"):
         train(config)

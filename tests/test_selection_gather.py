@@ -183,6 +183,206 @@ def test_no_retrace_and_static_shapes_across_rounds(tmp_session_dir):
     assert session._jitted_round_fn._cache_size() == 0
 
 
+def _obd_config(save_dir, gather, rounds=3, phase2=1, k=5, workers=8):
+    config = fed_avg_config(
+        distributed_algorithm="fed_obd",
+        executor="spmd",
+        worker_number=workers,
+        round=rounds,
+        epoch=1,
+        batch_size=16,
+        dataset_kwargs={"train_size": 128, "val_size": 16, "test_size": 32},
+        algorithm_kwargs={
+            "dropout_rate": 0.3,
+            "second_phase_epoch": phase2,
+            "early_stop": False,
+            "random_client_number": k,
+            "selection_gather": gather,
+        },
+        endpoint_kwargs={
+            "server": {"weight": 0.01},
+            "worker": {"weight": 0.01},
+        },
+        save_dir=save_dir,
+    )
+    config.load_config_and_process()
+    return config
+
+
+def test_obd_gather_vs_dense_bit_exact_across_phases(tmp_session_dir):
+    """The FedOBD acceptance pin: with random_client_number active the
+    gather path trains only the gathered phase-1 cohort, yet the whole
+    two-phase trajectory — per-aggregate metrics, wire accounting, the
+    final exact aggregate AND the phase-2 optimizer continuation seeded
+    across the boundary — matches the dense zero-masking path bit-exactly
+    (both paths merge per-slot optimizer states by participation, so the
+    phase-2 seed is identical)."""
+    dense = train(_obd_config("obd_dense", gather=False))
+    gathered = train(_obd_config("obd_gather", gather=True))
+    assert set(dense["performance"]) == set(gathered["performance"])
+    for key in sorted(dense["performance"]):
+        a, b = dense["performance"][key], gathered["performance"][key]
+        assert a["test_accuracy"] == b["test_accuracy"], (key, a, b)
+        assert a["test_loss"] == b["test_loss"], (key, a, b)
+        if key > 0:
+            assert a["received_mb"] == b["received_mb"], key
+    pa = _final_params("obd_dense", 4)
+    pb = _final_params("obd_gather", 4)
+    for key in pa:
+        np.testing.assert_array_equal(pa[key], pb[key], err_msg=key)
+
+
+def test_obd_phase2_gather_program_parity(tmp_session_dir):
+    """The phase-2 gather twin (take the carried opt states at the
+    selected ids, train the gathered cohort with continuation, scatter
+    the states back) reproduces the dense phase-2 program on the
+    aggregate, the broadcast, and every SELECTED slot's optimizer state;
+    unselected slots keep their carried states untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_learning_simulator_tpu.parallel.spmd_obd import (
+        SpmdFedOBDSession,
+    )
+
+    config = _obd_config("obd_p2", gather=True, rounds=1, phase2=1)
+    ctx = _build_task(config)
+    session = SpmdFedOBDSession(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+    assert session._selection_gather
+    phase2 = session._build_phase_fn(phase_two=True)
+    params = jax.device_put(
+        ctx.engine.init_params(config.seed), session._replicated
+    )
+    opt0 = jax.jit(
+        jax.vmap(
+            ctx.engine.optimizer.init, in_axes=None, axis_size=session.n_slots
+        )
+    )(params)
+    host_idx, host_w = session._select_indices(1)
+    rng = jax.random.PRNGKey(7)
+    host_keys = np.asarray(jax.random.split(rng, session.n_slots))
+    bcast_rng = jax.random.PRNGKey(11)
+
+    def put(x):
+        return jax.device_put(x, session._client_sharding)
+
+    # dense: full population weights masked to the same selection
+    dense_w = np.zeros(session.n_slots, np.float32)
+    dense_w[host_idx[host_w > 0]] = host_w[host_w > 0]
+    d_exact, d_bcast, d_opt, d_met = phase2(
+        jax.tree.map(jnp.copy, params),
+        put(dense_w),
+        put(host_keys),
+        bcast_rng,
+        jax.tree.map(jnp.copy, opt0),
+    )
+    g_exact, g_bcast, g_opt, g_met = phase2(
+        jax.tree.map(jnp.copy, params),
+        put(host_w),
+        put(host_keys[host_idx]),
+        bcast_rng,
+        jax.tree.map(jnp.copy, opt0),
+        sel_idx=put(host_idx),
+    )
+    for key in d_exact:
+        np.testing.assert_array_equal(
+            np.asarray(d_exact[key]), np.asarray(g_exact[key]), err_msg=key
+        )
+        np.testing.assert_array_equal(
+            np.asarray(d_bcast[key]), np.asarray(g_bcast[key]), err_msg=key
+        )
+    assert float(np.asarray(d_met["upload_bits"])) == float(
+        np.asarray(g_met["upload_bits"])
+    )
+    selected = np.asarray(host_idx[host_w > 0])
+    unselected = np.setdiff1d(np.arange(session.n_slots), selected)
+    for d_leaf, g_leaf, o_leaf in zip(
+        jax.tree.leaves(d_opt), jax.tree.leaves(g_opt), jax.tree.leaves(opt0)
+    ):
+        d_leaf, g_leaf, o_leaf = map(np.asarray, (d_leaf, g_leaf, o_leaf))
+        np.testing.assert_array_equal(d_leaf[selected], g_leaf[selected])
+        # the gather never touched the unselected slots' carried states
+        np.testing.assert_array_equal(g_leaf[unselected], o_leaf[unselected])
+
+
+def test_obd_expert_parallel_gather_falls_back_loudly(tmp_session_dir):
+    """The expert-parallel FedOBD subclass lays clients out as a
+    whole-mesh scan — requesting the gather must warn and run dense."""
+    from distributed_learning_simulator_tpu.config import (
+        DistributedTrainingConfig,
+    )
+
+    config = DistributedTrainingConfig(
+        dataset_name="imdb",
+        model_name="MoETransformerClassificationModel",
+        distributed_algorithm="fed_obd",
+        executor="spmd",
+        worker_number=4,
+        batch_size=4,
+        round=2,
+        epoch=1,
+        learning_rate=0.05,
+        algorithm_kwargs={
+            "dropout_rate": 0.3,
+            "second_phase_epoch": 1,
+            "random_client_number": 2,
+            "selection_gather": True,
+        },
+        endpoint_kwargs={
+            "server": {"weight": 0.01},
+            "worker": {"weight": 0.01},
+        },
+        dataset_kwargs={
+            "train_size": 16,
+            "val_size": 4,
+            "test_size": 8,
+            "max_len": 16,
+        },
+        model_kwargs={
+            "d_model": 16,
+            "nhead": 2,
+            "num_encoder_layer": 2,
+            "n_experts": 4,
+            "max_len": 16,
+            "expert_parallel": 4,
+        },
+    )
+    config.load_config_and_process()
+    ctx = _build_task(config)
+    from distributed_learning_simulator_tpu.engine.engine import ComputeEngine
+    from distributed_learning_simulator_tpu.parallel.spmd_obd_ep import (
+        SpmdFedOBDExpertParallelSession,
+    )
+
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda r: records.append(r.getMessage())
+    logger = get_logger()
+    logger.addHandler(handler)
+    try:
+        session = SpmdFedOBDExpertParallelSession(
+            ctx.config,
+            ctx.dataset_collection,
+            ctx.model_ctx,
+            ctx.engine,
+            ctx.practitioners,
+            expert_parallel=4,
+        )
+    finally:
+        logger.removeHandler(handler)
+    assert not session._selection_gather
+    assert session.s_pad == session.n_slots
+    assert any(
+        "selection_gather" in m and "dense" in m for m in records
+    )
+
+
 def test_fsdp_falls_back_loudly(tmp_session_dir):
     """FSDP stores params in the dense slot layout — requesting the gather
     must warn and run dense, not silently drop the flag."""
