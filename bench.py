@@ -752,6 +752,23 @@ def measure_long_context() -> dict:
     return out
 
 
+def measure_lint() -> int:
+    """Total jaxlint findings (audited included) from ``python -m
+    tools.jaxlint --format json`` — the analyzer-health count the bench
+    contract tracks.  Exits non-zero (un-audited findings) still yield
+    the count; only a crashed/unparseable run raises."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", "--format", "json"],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    return int(json.loads(proc.stdout)["total_findings"])
+
+
 def main() -> None:
     value, mfu = measure_spmd()
     try:
@@ -805,6 +822,13 @@ def main() -> None:
     except Exception as exc:
         obd_fusion = {"error": str(exc)[:200]}
     obd_fused = obd_fusion.get(f"gather_h{OBD_HORIZON}", {})
+    # analyzer health: total jaxlint findings over the package (every one
+    # audited in tools/jaxlint/allowlist.txt — un-audited findings fail
+    # tier-1, so this counts the standing audited-hazard surface)
+    try:
+        lint_findings = measure_lint()
+    except Exception:
+        lint_findings = -1
     # canonical north-star workloads (VERDICT r4 item 7): full
     # gtg_shapley_train.sh / fed_obd_train.sh runs are ~1 h on-chip, so
     # they are measured once per machine by tools/run_canonical.py and
@@ -883,6 +907,7 @@ def main() -> None:
                     "speedup": obd_fusion.get("speedup", 0.0),
                 },
                 "obd_fusion": obd_fusion,
+                "lint_findings": lint_findings,
                 "canonical": canonical,
             }
         )

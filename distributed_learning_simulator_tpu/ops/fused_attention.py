@@ -68,6 +68,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_compat import interpret_param
+
 LANE = 128
 MIN_FUSED_T = 1024  # below this XLA's batched-matmul attention is faster
 MAX_FUSED_T = 8192  # full K/V rows per (batch, head) must fit VMEM
@@ -112,7 +114,7 @@ def _mode() -> str:
 
 
 def _interp(interpret: bool):
-    return pltpu.InterpretParams() if interpret else False
+    return interpret_param(interpret)
 
 
 # ----------------------------------------------------------------- forward

@@ -1,0 +1,22 @@
+"""Pallas interpret-mode compat across jax versions.
+
+Newer jax spells interpreter mode ``interpret=pltpu.InterpretParams()``
+(a config object carrying TPU-interpreter options); the 0.4 line (this
+container ships 0.4.37) has no ``InterpretParams`` and takes the older
+``interpret=True`` boolean.  Every kernel call site routes through
+:func:`interpret_param` so the whole kernel layer follows whichever API
+the installed jax exposes.
+"""
+
+from jax.experimental.pallas import tpu as pltpu
+
+
+def interpret_param(interpret: bool):
+    """Value for ``pl.pallas_call(..., interpret=...)``: the TPU
+    interpreter params object where the API has one, the legacy boolean
+    otherwise; ``False`` always means compiled."""
+    if not interpret:
+        return False
+    if hasattr(pltpu, "InterpretParams"):
+        return pltpu.InterpretParams()
+    return True

@@ -31,6 +31,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_compat import interpret_param
+
 LANE = 128
 
 
@@ -137,7 +139,7 @@ def qsgd_encode(x: jnp.ndarray, seed, level: int, bits: int):
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ),
-        interpret=pltpu.InterpretParams() if interpret else False,
+        interpret=interpret_param(interpret),
     )(x2d, aux)
 
 
@@ -174,7 +176,7 @@ def qsgd_decode(packed, signs, scale, level: int, bits: int, n: int):
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        interpret=pltpu.InterpretParams() if use_interpret() else False,
+        interpret=interpret_param(use_interpret()),
     )(packed, signs, scale)
     return out.reshape(-1)[:n]
 
@@ -215,6 +217,6 @@ def weighted_accum(stacked: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((blk, LANE), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        interpret=pltpu.InterpretParams() if use_interpret() else False,
+        interpret=interpret_param(use_interpret()),
     )(x3d, weights.astype(jnp.float32))
     return out.reshape(-1)[:n]

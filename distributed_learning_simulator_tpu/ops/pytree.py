@@ -274,7 +274,12 @@ def tree_cast(tree, dtype):
 
 
 def tree_to_numpy(tree):
-    return jax.tree.map(lambda x: np.asarray(x), tree)
+    """Host copies of a device pytree.  REAL copies, not ``np.asarray``
+    views: on the cpu backend ``np.asarray`` of a device array aliases the
+    device buffer, and a snapshot that aliases a later-donated buffer
+    mutates under the donating program (the PR 3 parity incident —
+    docs/jax_hazards.md, zero-copy-view)."""
+    return jax.tree.map(lambda x: np.array(x, copy=True), tree)
 
 
 def param_nbytes(tree: Any) -> int:

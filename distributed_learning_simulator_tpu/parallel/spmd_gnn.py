@@ -540,7 +540,9 @@ class SpmdFedAASSession(SpmdFedGNNSession):
             "num_neighbor", config.extra_hyper_parameters.get("num_neighbor")
         )
         self._base_local = np.asarray(self._data["local_edges"]).astype(bool)
-        self._dst = np.asarray(self._data["edge_index"])[1]
+        # real copy: edge_index is a device array (put_sharded), and a
+        # zero-copy row view kept on self would alias the device buffer
+        self._dst = np.asarray(self._data["edge_index"])[1].copy()
 
     def _before_round(self, round_number: int) -> None:
         if self._num_neighbor is None:

@@ -4,6 +4,11 @@ set -e
 
 run() { python3 ./simulator.py "$@"; }
 
+# correctness gate ahead of the smoke runs (and of pytest in CI): the
+# jaxlint sweep must be clean — zero un-audited findings, no stale
+# allowlist entries (tools/jaxlint, docs/jax_hazards.md)
+python3 -m tools.jaxlint
+
 for cfg in fed_avg/mnist fed_avg/imdb; do
   algo=${cfg%%/*}
   run --config-name "$cfg.yaml" \

@@ -93,6 +93,7 @@ def test_bench_main_prints_one_json_line(monkeypatch):
             "wasted_compute_fraction": 0.0,
         },
     )
+    monkeypatch.setattr(bench, "measure_lint", lambda: 38)
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     bench.main()
@@ -119,6 +120,7 @@ def test_bench_main_prints_one_json_line(monkeypatch):
         "selection",
         "obd_fusion_path",
         "obd_fusion",
+        "lint_findings",
     ):
         assert field in payload, field
     assert payload["metric"] == "fedavg_cifar10_100clients_rounds_per_sec"
@@ -145,6 +147,9 @@ def test_bench_main_prints_one_json_line(monkeypatch):
     assert obd["dispatches_per_round"] < 1.0
     assert obd["speedup"] == 2.5
     assert "dense_h1" in payload["obd_fusion"]
+    # analyzer health: the audited jaxlint finding count (count only —
+    # the per-finding detail lives in the analyzer's own JSON output)
+    assert payload["lint_findings"] == 38
 
 
 def test_bench_main_survives_measurement_failures(monkeypatch):
@@ -164,6 +169,7 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     monkeypatch.setattr(bench, "measure_round_horizon", boom)
     monkeypatch.setattr(bench, "measure_obd_horizon", boom)
     monkeypatch.setattr(bench, "measure_selection_gather", boom)
+    monkeypatch.setattr(bench, "measure_lint", boom)
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     bench.main()
@@ -188,3 +194,5 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     assert "error" in payload["obd_fusion"]
     assert payload["obd_fusion_path"]["selection_path"] == "gather"
     assert payload["obd_fusion_path"]["dispatches_per_round"] == 0.0
+    # lint count degrades to -1 (never a missing field, never a crash)
+    assert payload["lint_findings"] == -1

@@ -44,8 +44,14 @@ def test_fed_avg_end_to_end(tmp_session_dir):
 
 
 def test_fed_avg_learns(tmp_session_dir):
-    # synthetic MNIST is nearly linearly separable: 3 rounds must beat chance
-    config = make_config(round=3, epoch=2)
+    # synthetic MNIST is nearly linearly separable — but the old 3-round
+    # lr=0.05 slice sat right at the knee of the learning curve (best
+    # 0.22, chance 0.1) and flaked on the cpu backend.  Re-baselined:
+    # seed pinned explicitly (the synthetic data itself is seeded by
+    # dataset NAME, so all run-to-run variance came from training), 5
+    # rounds at lr=0.1 reaches test accuracy 1.0 deterministically
+    # (bit-identical across repeat runs) — 2x headroom over the 0.5 bar
+    config = make_config(round=5, epoch=2, learning_rate=0.1, seed=0)
     result = train(config)
     final = max(result["performance"].values(), key=lambda s: s["test_accuracy"])
     assert final["test_accuracy"] > 0.5

@@ -1,0 +1,186 @@
+"""jaxlint (``tools/jaxlint``) pinned in tier-1.
+
+Three contracts:
+
+* **golden corpus** — every ``pos_*`` snippet in
+  ``tools/jaxlint/corpus/<rule>/`` is flagged by its rule, every
+  ``neg_*`` snippet is clean, and the three HISTORICAL bug
+  reconstructions (PR 2 donation aliasing, PR 3 zero-copy snapshot,
+  PR 4 count-dependent split) are detected — reintroducing any of those
+  bug classes trips the analyzer;
+* **repo-wide pin** — all five rules over the package produce ZERO
+  un-audited findings against ``tools/jaxlint/allowlist.txt``, and no
+  allowlist entry is stale.  A new finding fails here until the code is
+  fixed or the site is audited WITH a written justification;
+* **allowlist hygiene** — entries require a justification; malformed or
+  duplicate entries are load errors.
+
+``tests/test_donation_lint.py`` keeps pinning the device-put sub-rule
+through the compat shim.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.jaxlint import (  # noqa: E402
+    DEFAULT_ALLOWLIST,
+    RULES,
+    AllowlistError,
+    load_allowlist,
+    run_rules,
+)
+
+PACKAGE = os.path.join(REPO, "distributed_learning_simulator_tpu")
+CORPUS = os.path.join(REPO, "tools", "jaxlint", "corpus")
+
+#: rule name -> corpus directory
+RULE_DIRS = {name: name.replace("-", "_") for name in RULES}
+
+#: historical incident reconstructions and the rule that must catch them
+HISTORICAL = {
+    "pr2_donation_aliasing.py": "use-after-donate",
+    "pr3_zero_copy_snapshot.py": "zero-copy-view",
+    "pr4_count_dependent_split.py": "rng-split-count-discipline",
+}
+
+
+def _corpus_files(rule_name: str, prefix: str) -> list[str]:
+    d = os.path.join(CORPUS, RULE_DIRS[rule_name])
+    return sorted(
+        os.path.join(d, f) for f in os.listdir(d) if f.startswith(prefix)
+    )
+
+
+@pytest.mark.parametrize("rule_name", sorted(RULES))
+def test_corpus_positives_flagged(rule_name):
+    files = _corpus_files(rule_name, "pos_")
+    assert files, f"no positive corpus for {rule_name}"
+    for path in files:
+        findings = run_rules([path], [RULES[rule_name]()])
+        assert findings, (
+            f"{os.path.basename(path)}: expected >=1 {rule_name} finding"
+        )
+
+
+@pytest.mark.parametrize("rule_name", sorted(RULES))
+def test_corpus_negatives_clean(rule_name):
+    files = _corpus_files(rule_name, "neg_")
+    assert files, f"no negative corpus for {rule_name}"
+    for path in files:
+        findings = run_rules([path], [RULES[rule_name]()])
+        assert not findings, (
+            f"{os.path.basename(path)}: expected clean, got"
+            f" {[f.key for f in findings]}"
+        )
+
+
+@pytest.mark.parametrize("filename", sorted(HISTORICAL))
+def test_historical_bug_reconstructions_detected(filename):
+    """Reintroducing any of the three shipped bug classes must trip the
+    analyzer — this is the analyzer's reason to exist."""
+    rule_name = HISTORICAL[filename]
+    path = os.path.join(CORPUS, "historical", filename)
+    findings = run_rules([path], [RULES[rule_name]()])
+    assert findings, f"{filename} not detected by {rule_name}"
+
+
+def test_finding_keys_are_relpath_scope_rule():
+    """Key format, and the device-put sub-rule's DISTINCT key: an audit
+    of a scope's device_put can never mute a dataflow use-after-donate
+    finding in the same scope."""
+    path = os.path.join(CORPUS, "historical", "pr2_donation_aliasing.py")
+    findings = run_rules([path], [RULES["use-after-donate"]()])
+    assert findings
+    for f in findings:
+        assert f.key.count("::") == 2, f.key
+        assert f.rule in ("use-after-donate", "use-after-donate/device-put")
+        assert f.path == "pr2_donation_aliasing.py", f.path
+    # the PR 2 reconstruction is a device-put incident
+    assert any(f.rule == "use-after-donate/device-put" for f in findings)
+    # same scope, both sub-rules -> two DIFFERENT allowlist keys
+    both = os.path.join(
+        CORPUS, "use_after_donate", "pos_dataflow.py"
+    )
+    dataflow = run_rules([both], [RULES["use-after-donate"]()])
+    assert any(f.rule == "use-after-donate" for f in dataflow)
+
+
+# ---------------------------------------------------------------- tier-1 pin
+def test_package_zero_unaudited_findings():
+    """THE standing pin: all five rules over the whole package, every
+    finding audited, no stale audit."""
+    findings = run_rules([PACKAGE], [cls() for cls in RULES.values()])
+    allow = load_allowlist(DEFAULT_ALLOWLIST)
+    keys = {f.key for f in findings}
+    unaudited = keys - set(allow)
+    stale = set(allow) - keys
+    assert not unaudited, (
+        "un-audited jaxlint findings — fix the code, or audit the site"
+        " and add it to tools/jaxlint/allowlist.txt WITH a justification"
+        f" (docs/jax_hazards.md): {sorted(unaudited)}"
+    )
+    assert not stale, (
+        f"stale allowlist entries to remove: {sorted(stale)}"
+    )
+
+
+def test_allowlist_entries_all_carry_justifications():
+    allow = load_allowlist(DEFAULT_ALLOWLIST)
+    assert allow, "allowlist unexpectedly empty"
+    for key, justification in allow.items():
+        assert key.count("::") == 2, key
+        assert justification.strip(), f"missing justification: {key}"
+
+
+# ---------------------------------------------------------------- hygiene
+def test_allowlist_requires_justification(tmp_path):
+    p = tmp_path / "allow.txt"
+    p.write_text("pkg/a.py::f::use-after-donate =\n")
+    with pytest.raises(AllowlistError):
+        load_allowlist(str(p))
+
+
+def test_allowlist_rejects_malformed_key(tmp_path):
+    p = tmp_path / "allow.txt"
+    p.write_text("pkg/a.py::f = looks audited but has no rule\n")
+    with pytest.raises(AllowlistError):
+        load_allowlist(str(p))
+
+
+def test_allowlist_rejects_duplicates(tmp_path):
+    p = tmp_path / "allow.txt"
+    p.write_text(
+        "pkg/a.py::f::zero-copy-view = first\n"
+        "pkg/a.py::f::zero-copy-view = second\n"
+    )
+    with pytest.raises(AllowlistError):
+        load_allowlist(str(p))
+
+
+def test_cli_json_contract():
+    """``python -m tools.jaxlint --format json`` exits 0 on the audited
+    package and emits the machine-readable summary bench.py consumes."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", "--format", "json"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert sorted(payload["rules"]) == sorted(RULES)
+    assert payload["unaudited"] == 0
+    assert payload["stale_allowlist"] == []
+    assert payload["total_findings"] == payload["allowlisted"]
+    assert len(payload["findings"]) == payload["total_findings"]
+    for row in payload["findings"]:
+        assert row["allowlisted"] is True
+        assert row["justification"].strip()

@@ -1,113 +1,46 @@
-"""Donation-aliasing lint: find ``jax.device_put(`` call sites that are
-not wrapped in an intervening ``jnp.copy``.
+"""Donation-aliasing lint — COMPAT SHIM over ``tools/jaxlint``.
 
-The latent bug class PR 2 fixed (``_place_params`` NaN/segfault): on the
-cpu backend ``jax.device_put`` of an ALIGNED HOST NUMPY array returns a
-zero-copy view — XLA and the python heap share the buffer.  If that
-result then flows into a jitted program's DONATED argument, XLA reuses
-memory python still owns: silent heap corruption, NaN trajectories after
-every npz resume, segfaults under the async checkpoint writer.  The fix
-is an on-device copy (``jnp.copy`` / ``jax.tree.map(jnp.copy, ...)``)
-whose outputs are XLA-allocated.
-
-A full dataflow proof is out of scope for a lint; instead this pass
-enumerates every ``jax.device_put`` call whose own expression does not
-already copy, and the tier-1 test (``tests/test_donation_lint.py``) pins
-the result against an AUDITED allowlist — each entry hand-checked to
-never feed a donated argument (or to place device-owned arrays, which
-never alias the python heap).  Adding a new un-audited ``device_put``
-fails the suite until someone audits it.
-
-Sites are keyed ``<relpath>::<enclosing def>`` (stable under line drift).
+The single-rule lint this file used to implement (``jax.device_put`` call
+sites not wrapped in an intervening ``jnp.copy`` — the ``_place_params``
+NaN/segfault class PR 2 fixed) graduated into the multi-pass analyzer as
+the device-put sub-rule of ``use-after-donate``
+(``tools/jaxlint/rules/use_after_donate.py``).  This shim keeps the
+historical entry points alive for existing callers
+(``tests/test_donation_lint.py``) with the original
+``<relpath>::<enclosing def>`` key format; new code should run
+``python -m tools.jaxlint`` and key against the shared allowlist
+(``tools/jaxlint/allowlist.txt``).  See docs/jax_hazards.md.
 """
 
-import ast
 import os
+import sys
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-def _dotted_name(func: ast.AST) -> str:
-    parts = []
-    node = func
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-    return ".".join(reversed(parts))
-
-
-def _is_copy_wrapper(call: ast.Call) -> bool:
-    """The call textually applies a copy to its inputs: ``jnp.copy(...)``
-    or a tree map whose mapped function is ``...copy``."""
-    name = _dotted_name(call.func)
-    if name.endswith(".copy") or name == "copy":
-        return True
-    if name in ("jax.tree.map", "jax.tree_util.tree_map", "tree.map") and call.args:
-        first = call.args[0]
-        first_name = (
-            _dotted_name(first)
-            if isinstance(first, (ast.Attribute, ast.Name))
-            else ""
-        )
-        return first_name.endswith("copy")
-    return False
+from tools.jaxlint.engine import iter_file_contexts  # noqa: E402
+from tools.jaxlint.rules.use_after_donate import device_put_sites  # noqa: E402
 
 
 def find_unwrapped_device_put(pkg_root: str) -> list[str]:
     """``<relpath>::<enclosing def>`` for every ``jax.device_put`` call
-    not wrapped in a copy within its own expression, sorted."""
+    not wrapped in a copy within its own expression, sorted — the
+    historical contract, served by the jaxlint sub-rule."""
     findings: set[str] = set()
-    base = os.path.dirname(os.path.abspath(pkg_root))
-    for dirpath, _dirs, files in os.walk(pkg_root):
-        for name in sorted(files):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            with open(path, encoding="utf8") as f:
-                tree = ast.parse(f.read())
-            parents: dict[ast.AST, ast.AST] = {}
-            for parent in ast.walk(tree):
-                for child in ast.iter_child_nodes(parent):
-                    parents[child] = parent
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                if _dotted_name(node.func) not in (
-                    "jax.device_put",
-                    "device_put",
-                ):
-                    continue
-                wrapped = False
-                scope = "<module>"
-                cur = parents.get(node)
-                while cur is not None:
-                    if isinstance(cur, ast.Call) and _is_copy_wrapper(cur):
-                        wrapped = True
-                    if (
-                        isinstance(
-                            cur, (ast.FunctionDef, ast.AsyncFunctionDef)
-                        )
-                        and scope == "<module>"
-                    ):
-                        scope = cur.name
-                    cur = parents.get(cur)
-                if not wrapped:
-                    rel = os.path.relpath(path, base).replace(os.sep, "/")
-                    findings.add(f"{rel}::{scope}")
+    for ctx in iter_file_contexts([pkg_root]):
+        for finding in device_put_sites(ctx):
+            findings.add(f"{finding.path}::{finding.scope}")
     return sorted(findings)
 
 
 def main() -> None:
     import json
-    import sys
 
     pkg = (
         sys.argv[1]
         if len(sys.argv) > 1
-        else os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "distributed_learning_simulator_tpu",
-        )
+        else os.path.join(_REPO, "distributed_learning_simulator_tpu")
     )
     print(json.dumps(find_unwrapped_device_put(pkg), indent=2))
 

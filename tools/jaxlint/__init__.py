@@ -1,0 +1,30 @@
+"""jaxlint: multi-pass JAX-correctness static analyzer for the SPMD stack.
+
+Five rules over a shared one-parse-per-file engine, pinned in tier-1
+against an audited allowlist (``tests/test_jaxlint.py``):
+
+* ``use-after-donate`` — donation aliasing (the PR 2 class)
+* ``host-sync-in-hot-loop`` — blocking fetches in round loops/scan bodies
+* ``rng-split-count-discipline`` — count-dependent split prefixes (PR 4)
+* ``retrace-hazard`` — trace-cache-defeating call patterns
+* ``zero-copy-view`` — escaping ``np.asarray`` views (the PR 3 class)
+
+CLI: ``python -m tools.jaxlint [paths] --rule R --allowlist F --format
+json``.  Hazard catalogue and audit workflow: ``docs/jax_hazards.md``.
+"""
+
+from .allowlist import DEFAULT_ALLOWLIST, AllowlistError, load_allowlist
+from .engine import FileContext, Finding, Rule, iter_file_contexts, run_rules
+from .rules import RULES
+
+__all__ = [
+    "RULES",
+    "Finding",
+    "FileContext",
+    "Rule",
+    "run_rules",
+    "iter_file_contexts",
+    "load_allowlist",
+    "AllowlistError",
+    "DEFAULT_ALLOWLIST",
+]

@@ -1,0 +1,143 @@
+"""CLI: ``python -m tools.jaxlint [paths] [--rule R]... [--allowlist F]
+[--format text|json]``.
+
+Exit status: 0 clean (every finding allowlisted, no stale entries),
+1 on un-audited findings or stale allowlist entries, 2 on usage errors.
+Default paths: the ``distributed_learning_simulator_tpu`` package.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .allowlist import DEFAULT_ALLOWLIST, AllowlistError, load_allowlist
+from .engine import run_rules
+from .rules import RULES
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_PACKAGE = os.path.join(REPO, "distributed_learning_simulator_tpu")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.jaxlint",
+        description="multi-pass JAX-correctness static analyzer"
+        " (docs/jax_hazards.md)",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to lint (default: the"
+        " distributed_learning_simulator_tpu package)",
+    )
+    parser.add_argument(
+        "--rule",
+        action="append",
+        choices=sorted(RULES),
+        help="run only this rule (repeatable; default: all rules)",
+    )
+    parser.add_argument(
+        "--allowlist",
+        default=DEFAULT_ALLOWLIST,
+        help="audited allowlist file, or 'none' to disable"
+        f" (default: {os.path.relpath(DEFAULT_ALLOWLIST, REPO)})",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="report format (default: text)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    return parser
+
+
+def run(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for name in RULES:
+            print(f"{name}: {RULES[name].description}")
+        return 0
+    rule_names = args.rule or list(RULES)
+    rules = [RULES[name]() for name in rule_names]
+    explicit_paths = bool(args.paths)
+    paths = args.paths or [DEFAULT_PACKAGE]
+    allow: dict[str, str] = {}
+    if args.allowlist != "none":
+        try:
+            allow = load_allowlist(args.allowlist)
+        except FileNotFoundError:
+            print(
+                f"jaxlint: allowlist not found: {args.allowlist}",
+                file=sys.stderr,
+            )
+            return 2
+        except AllowlistError as exc:
+            print(f"jaxlint: {exc}", file=sys.stderr)
+            return 2
+    # keys are repo-relative whenever the target lives in this repo, so
+    # a subdir run (`python -m tools.jaxlint distributed_.../parallel`)
+    # matches the same allowlist entries as the full sweep
+    base = (
+        REPO
+        if all(
+            os.path.abspath(p).startswith(REPO + os.sep) for p in paths
+        )
+        else None
+    )
+    findings = run_rules(paths, rules, base=base)
+    found_keys = {f.key for f in findings}
+    unaudited = [f for f in findings if f.key not in allow]
+    # stale detection only makes sense on a full default-package run with
+    # every rule selected — a narrowed run simply cannot see the entries
+    stale: list[str] = []
+    if not explicit_paths and not args.rule:
+        stale = sorted(set(allow) - found_keys)
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "rules": rule_names,
+                    "total_findings": len(findings),
+                    "allowlisted": len(findings) - len(unaudited),
+                    "unaudited": len(unaudited),
+                    "stale_allowlist": stale,
+                    "findings": [
+                        {
+                            **f.as_dict(),
+                            "allowlisted": f.key in allow,
+                            **(
+                                {"justification": allow[f.key]}
+                                if f.key in allow
+                                else {}
+                            ),
+                        }
+                        for f in findings
+                    ],
+                }
+            )
+        )
+    else:
+        for f in unaudited:
+            print(f"{f.key}:{f.line}: {f.message}")
+        for key in stale:
+            print(f"stale allowlist entry (no longer found): {key}")
+        audited = len(findings) - len(unaudited)
+        print(
+            f"jaxlint: {len(findings)} finding(s)"
+            f" ({audited} audited, {len(unaudited)} un-audited,"
+            f" {len(stale)} stale allowlist entr(y/ies))"
+            f" across {len(rule_names)} rule(s)"
+        )
+    return 1 if unaudited or stale else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

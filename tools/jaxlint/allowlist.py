@@ -1,0 +1,62 @@
+"""Audited-allowlist file handling.
+
+Format (one entry per line)::
+
+    <relpath>::<scope>::<rule> = <justification>
+
+The justification is REQUIRED and non-empty: an allowlist entry is a
+written audit record, not a mute button.  ``#`` lines and blank lines are
+comments.  Keys carry no line numbers, so an audited site survives
+unrelated edits to its file; the tier-1 test also fails on STALE entries
+(key no longer found) so dead audits are cleaned up, mirroring the
+donation_lint contract.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class AllowlistError(ValueError):
+    pass
+
+
+DEFAULT_ALLOWLIST = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "allowlist.txt"
+)
+
+
+def load_allowlist(path: str) -> dict[str, str]:
+    """``key -> justification``; raises :class:`AllowlistError` on a
+    malformed line, a missing justification, or a duplicate key."""
+    entries: dict[str, str] = {}
+    with open(path, encoding="utf8") as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            key, sep, justification = line.partition("=")
+            key = key.strip()
+            justification = justification.strip()
+            if not sep:
+                raise AllowlistError(
+                    f"{path}:{lineno}: expected"
+                    " '<relpath>::<scope>::<rule> = <justification>'"
+                )
+            if key.count("::") != 2:
+                raise AllowlistError(
+                    f"{path}:{lineno}: key must be"
+                    f" '<relpath>::<scope>::<rule>', got {key!r}"
+                )
+            if not justification:
+                raise AllowlistError(
+                    f"{path}:{lineno}: a written justification is"
+                    f" required for {key!r} — an allowlist entry is an"
+                    " audit record"
+                )
+            if key in entries:
+                raise AllowlistError(
+                    f"{path}:{lineno}: duplicate entry {key!r}"
+                )
+            entries[key] = justification
+    return entries

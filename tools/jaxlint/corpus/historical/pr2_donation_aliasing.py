@@ -1,0 +1,27 @@
+"""PR 2 `_place_params` reconstruction (donation aliasing).
+
+``jax.device_put`` of ALIGNED HOST NUMPY (an npz resume) returns a
+zero-copy view on the cpu backend — XLA and the python heap share the
+buffer.  The round program DONATES its params argument, so XLA wrote
+through memory python still owned: NaN trajectories after every SPMD
+resume, segfaults under the async checkpoint writer.  The fix was an
+on-device copy (``jax.tree.map(jnp.copy, ...)``).
+
+Expected: use-after-donate (device-put sub-rule).
+"""
+
+import jax
+import numpy as np
+
+
+def _place_params(host_params, sharding):
+    # BUG: no jnp.copy — the placed arrays may alias the python heap
+    return {k: jax.device_put(v, sharding) for k, v in host_params.items()}
+
+
+def resume(round_fn, npz_path, sharding, weights, rngs):
+    host = dict(np.load(npz_path))
+    params = _place_params(host, sharding)
+    # round_fn donates params: XLA reuses (and writes through) the
+    # aliased host buffer
+    return round_fn(params, weights, rngs)

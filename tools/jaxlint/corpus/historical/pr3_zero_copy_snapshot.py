@@ -1,0 +1,24 @@
+"""PR 3 parity-baseline reconstruction (zero-copy snapshot).
+
+The fedavg-parity test "snapshotted" the replicated cpu params with
+``np.asarray`` — a zero-copy VIEW of the device buffer — then ran the
+round program, which DONATES its params argument.  The "snapshot"
+mutated under the replay, so the parity check compared the run against
+a corrupted baseline and failed.  The fix: take real copies.
+
+Expected: zero-copy-view.
+"""
+
+import numpy as np
+
+
+def snapshot_params(params):
+    # BUG: zero-copy views of the (about to be donated) device buffers
+    return {k: np.asarray(v) for k, v in params.items()}
+
+
+class ParityHarness:
+    def run_one_round(self, round_fn, params, weights, rngs):
+        self._baseline = snapshot_params(params)
+        new_params = round_fn(params, weights, rngs)  # donates params
+        return new_params

@@ -1,0 +1,23 @@
+"""PR 4 OBD expert-parallel reconstruction (count-dependent split).
+
+The ep/sp OBD sessions derived per-client keys from
+``split(round_rng, n_slots)`` with their OWN (clients-axis-less) slot
+count.  On non-partitionable threefry, split PREFIXES depend on the
+count — ``split(key, 1)`` != ``split(key, 8)[:1]`` — so trajectories
+silently diverged from the client-axis session wherever the model
+consumed training rng.  The fix: every layout splits to the canonical
+full-population default-mesh count (``_stream_slots``) and takes its
+rows.
+
+Expected: rng-split-count-discipline.
+"""
+
+import jax
+
+
+class EpObdSession:
+    def _client_keys(self, round_rng):
+        # BUG: layout-local slot count (1 for whole-mesh-per-client
+        # layouts) instead of the canonical full-population count
+        n_slots = self.client_slot_count
+        return jax.random.split(round_rng, n_slots)

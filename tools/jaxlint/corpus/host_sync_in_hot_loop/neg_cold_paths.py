@@ -1,0 +1,23 @@
+"""Negative: syncs outside loops, loops outside run paths, and
+host-side casts the rule knows are free."""
+
+import numpy as np
+
+
+class Session:
+    def run(self):
+        out = self._round_fn(self.params)
+        for r in range(3):
+            n = int(len(self._batches))  # len() is already host-side
+            self._note(r, n)
+        return float(out["accuracy"])  # sync, but after the loop
+
+    def summarize(self):
+        total = 0.0
+        for m in self._metrics:  # not a run path: post-hoc reporting
+            total += float(m)
+        return total
+
+
+def stack(batches):
+    return np.asarray(batches)  # no loop, no scan body

@@ -1,0 +1,16 @@
+"""Positive: blocking host syncs inside a session run() round loop."""
+
+import jax
+import numpy as np
+
+
+class Session:
+    def run(self):
+        for round_number in range(self.rounds):
+            params, metrics = self._round_fn(self.params)
+            acc = float(metrics["accuracy"])  # device fetch per round
+            snap = np.asarray(params["w"])  # device fetch per round
+            jax.block_until_ready(params)  # full pipeline flush per round
+            loss = metrics["loss"].item()  # device fetch per round
+            self._log(round_number, acc, loss, snap)
+        return self._stat

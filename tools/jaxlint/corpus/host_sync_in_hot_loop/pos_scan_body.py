@@ -1,0 +1,14 @@
+"""Positive: a host sync inside a lax.scan body (trace-time error at
+best, hidden constant at worst)."""
+
+import jax
+import numpy as np
+
+
+def horizon(carry, xs):
+    def body(c, x):
+        c = c + x
+        host = np.asarray(c)  # host fetch of a tracer
+        return c, host
+
+    return jax.lax.scan(body, carry, xs)

@@ -1,0 +1,23 @@
+"""Negative: traced array args, constants at static positions, and
+shape-taking constructors inside jitted bodies."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def scaled(x, factor):
+    return x * factor
+
+
+@jax.jit
+def init(x):
+    return x + jnp.zeros((4,))
+
+
+def run(params, batches):
+    step = jax.jit(lambda p, b: p)
+    out = step(params, batches)
+    return scaled(out, 2)

@@ -1,0 +1,10 @@
+"""Positive: jnp.array(<python literal>) inside a jitted body — the
+literal is re-materialized as an on-device constant at every trace."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def body(x):
+    return x + jnp.array(1.0)

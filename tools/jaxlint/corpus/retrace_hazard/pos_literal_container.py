@@ -1,0 +1,8 @@
+"""Positive: fresh container literals passed to a jitted callable."""
+
+import jax
+
+
+def build(program, x):
+    jitted = jax.jit(program)
+    return jitted(x, {"lr": 0.1}, [1.0, 2.0])

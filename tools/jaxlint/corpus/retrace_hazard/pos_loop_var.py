@@ -1,0 +1,12 @@
+"""Positive: a python loop variable fed to a jitted callable — a fresh
+weak-typed constant every iteration retraces the program per round."""
+
+import jax
+
+step = jax.jit(lambda p, r: p)
+
+
+def run(params):
+    for round_number in range(10):
+        params = step(params, round_number)
+    return params

@@ -1,0 +1,19 @@
+"""Negative: the canonical full-population contract (split to
+_stream_slots, take rows), count-free splits, and non-population
+counts."""
+
+import jax
+
+
+class Session:
+    def _client_keys(self, round_rng, sel):
+        return jax.random.split(round_rng, self._stream_slots)[sel]
+
+
+def epoch_keys(rng, epochs):
+    return jax.random.split(rng, epochs)
+
+
+def advance(rng):
+    rng, sub = jax.random.split(rng)
+    return rng, sub

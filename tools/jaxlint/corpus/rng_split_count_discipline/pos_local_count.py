@@ -1,0 +1,13 @@
+"""Positive: split counts derived from layout-local slot/worker counts —
+split prefixes are count-dependent on threefry."""
+
+import jax
+
+
+class EpSession:
+    def _client_keys(self, round_rng):
+        return jax.random.split(round_rng, self.n_slots)
+
+
+def worker_keys(rng, worker_count):
+    return jax.random.split(rng, worker_count)

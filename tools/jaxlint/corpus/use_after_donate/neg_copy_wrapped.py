@@ -1,0 +1,13 @@
+"""Negative (device-put sub-rule): the device_put result is copied on
+device within the same expression — XLA owns the output buffers."""
+
+import jax
+import jax.numpy as jnp
+
+
+def place(x, sharding):
+    return jnp.copy(jax.device_put(x, sharding))
+
+
+def place_tree(tree, sharding):
+    return jax.tree.map(jnp.copy, jax.device_put(tree, sharding))

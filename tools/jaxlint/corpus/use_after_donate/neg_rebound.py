@@ -1,0 +1,10 @@
+"""Negative: the donated name is rebound by the donating statement —
+subsequent reads see the NEW value (the streaming-accumulator idiom)."""
+
+from ops import flat_acc_add  # known donated entry point (acc, pos 0)
+
+
+def stream(acc, uploads, weights):
+    for params, weight in zip(uploads, weights):
+        acc = flat_acc_add(acc, params, weight)
+    return acc

@@ -1,0 +1,17 @@
+"""Negative: the donation happens inside a `return` statement — control
+leaves the function, the sibling branch is not a later read (the
+session `fn` dispatcher idiom)."""
+
+import jax
+
+
+def build(program, gather_program):
+    jitted = jax.jit(program, donate_argnums=(0,))
+    gather_jitted = jax.jit(gather_program, donate_argnums=(0,))
+
+    def fn(params, weights, sel=None):
+        if sel is not None:
+            return gather_jitted(params, weights, sel)
+        return jitted(params, weights)
+
+    return fn

@@ -1,0 +1,11 @@
+"""Positive: a name read after being passed at a donated position."""
+
+import jax
+import jax.numpy as jnp
+
+
+def train(params, batches, _step=None):
+    step = jax.jit(_step, donate_argnums=(0,))
+    new_params = step(params, batches)
+    norm = jnp.linalg.norm(params["w"])  # read of the donated buffer
+    return new_params, norm

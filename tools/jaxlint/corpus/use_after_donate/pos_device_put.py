@@ -1,0 +1,9 @@
+"""Positive (device-put sub-rule): an unwrapped jax.device_put — on the
+cpu backend the result can alias the python-owned buffer."""
+
+import jax
+
+
+def place(host_arr, sharding):
+    placed = jax.device_put(host_arr, sharding)
+    return placed

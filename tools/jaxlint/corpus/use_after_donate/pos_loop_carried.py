@@ -1,0 +1,14 @@
+"""Positive (loop-carried): the read sits textually ABOVE the donating
+call, but inside the loop it re-executes AFTER the donation on every
+subsequent iteration — the buffer it reads was already reused by XLA."""
+
+import jax
+
+
+def run(params, rounds, log, _step=None):
+    step = jax.jit(_step, donate_argnums=(0,))
+    out = None
+    for r in rounds:
+        log(params)  # iterations 2..N read the donated buffer
+        out = step(params)
+    return out

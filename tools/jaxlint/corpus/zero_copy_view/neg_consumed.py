@@ -1,0 +1,26 @@
+"""Negative: views consumed before escaping (reduced to scalars, copied,
+or built from fresh python objects)."""
+
+import numpy as np
+
+
+def accuracy(confusion):
+    cm = np.asarray(confusion)
+    return float(cm.trace() / cm.sum())
+
+
+def flags(x):
+    return np.asarray(x).astype(bool)
+
+
+def sizes(items):
+    return np.asarray([float(len(i)) for i in items], np.float32)
+
+
+def padded(sizes_list, n):
+    return np.asarray(sizes_list + [0] * n, np.float32)
+
+
+class Holder:
+    def keep_copy(self, vec):
+        self._snap = np.asarray(vec).copy()

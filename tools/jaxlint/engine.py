@@ -1,0 +1,247 @@
+"""jaxlint engine: one parse + parent/scope map per file, shared by every
+rule pass.
+
+The analyzer grew out of ``tools/donation_lint.py`` (one rule, one audited
+allowlist, pinned in tier-1) after three of four consecutive PRs each
+root-caused a *latent* JAX hazard by hand — donation aliasing of
+python-owned buffers (PR 2), count-dependent ``jax.random.split`` prefixes
+(PR 4), zero-copy ``np.asarray`` views mutating under donated round
+programs (PR 3).  Rules are AST/dataflow passes over a shared
+:class:`FileContext`; findings are keyed ``relpath::scope::rule`` (stable
+under line drift) and pinned against an audited allowlist whose every
+entry carries a written justification (``tools/jaxlint/allowlist.txt``).
+
+See ``docs/jax_hazards.md`` for the hazard catalogue and the audit
+workflow.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from collections.abc import Iterable, Iterator
+
+
+JIT_NAMES = ("jax.jit", "jit")
+PARTIAL_NAMES = ("functools.partial", "partial")
+
+
+def is_jit_call(call: "ast.Call") -> bool:
+    """``jax.jit(...)`` or ``functools.partial(jax.jit, ...)`` — THE one
+    definition of jit-call detection shared by every rule."""
+    name = dotted_name(call.func)
+    if name in JIT_NAMES:
+        return True
+    return name in PARTIAL_NAMES and bool(
+        call.args and dotted_name(call.args[0]) in JIT_NAMES
+    )
+
+
+def int_positions_kwarg(
+    call: "ast.Call", kwarg: str, default=None
+) -> tuple[int, ...] | None:
+    """Statically parse an int/tuple-of-ints keyword (``donate_argnums``,
+    ``static_argnums``).  Returns ``default`` when the kwarg is absent,
+    and ``(0,)`` when present but not statically parseable (the
+    conservative donate assumption)."""
+    for kw in call.keywords:
+        if kw.arg != kwarg:
+            continue
+        node = kw.value
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return (node.value,)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            vals = tuple(
+                e.value
+                for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)
+            )
+            if vals:
+                return vals
+        return (0,)
+    return default
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``a.b.c`` for an Attribute/Name chain, '' for anything else."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        # chain rooted in a call/subscript — keep the attribute tail so
+        # ``self._round_fn``-style lookups still resolve by suffix
+        pass
+    return ".".join(reversed(parts))
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule hit.  ``key`` (``relpath::scope::rule``) is the allowlist
+    identity — line numbers are reported but never part of the key, so an
+    audited site survives unrelated edits to its file."""
+
+    rule: str
+    path: str  # repo-relative, '/'-separated
+    scope: str  # innermost enclosing def name, or '<module>'
+    line: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}::{self.scope}::{self.rule}"
+
+    def as_dict(self) -> dict:
+        return {
+            "key": self.key,
+            "rule": self.rule,
+            "path": self.path,
+            "scope": self.scope,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_CALLABLE_NODES = _FUNC_NODES + (ast.Lambda,)
+
+
+class FileContext:
+    """One parsed file: AST, parent map, and scope lookups — built once,
+    shared by all rule passes."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree = ast.parse(source)
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self._calls: list[ast.Call] | None = None
+        self._functions: list[ast.AST] | None = None
+
+    # ------------------------------------------------------------ lookups
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def scope_name(self, node: ast.AST) -> str:
+        """Innermost enclosing def's name (lambdas fall through to their
+        enclosing def) — the same key convention donation_lint used."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, _FUNC_NODES):
+                return anc.name
+        return "<module>"
+
+    def enclosing_callable(self, node: ast.AST) -> ast.AST | None:
+        """Nearest enclosing FunctionDef/AsyncFunctionDef/Lambda."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, _CALLABLE_NODES):
+                return anc
+        return None
+
+    def enclosing_statement(self, node: ast.AST) -> ast.stmt | None:
+        if isinstance(node, ast.stmt):
+            return node
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.stmt):
+                return anc
+        return None
+
+    def calls(self) -> list[ast.Call]:
+        if self._calls is None:
+            self._calls = [
+                n for n in ast.walk(self.tree) if isinstance(n, ast.Call)
+            ]
+        return self._calls
+
+    def functions(self) -> list[ast.AST]:
+        """Every def (sync + async), outermost first."""
+        if self._functions is None:
+            self._functions = [
+                n for n in ast.walk(self.tree) if isinstance(n, _FUNC_NODES)
+            ]
+        return self._functions
+
+    def owned_nodes(self, func: ast.AST) -> Iterator[ast.AST]:
+        """Nodes whose nearest enclosing callable is ``func`` — i.e. the
+        function's own body, excluding nested def/lambda bodies (their
+        execution time is unrelated to ``func``'s statement order)."""
+        for node in ast.walk(func):
+            if node is func:
+                continue
+            cur = self.parents.get(node)
+            while cur is not None and cur is not func:
+                if isinstance(cur, _CALLABLE_NODES):
+                    break
+                cur = self.parents.get(cur)
+            if cur is func:
+                yield node
+
+    # ------------------------------------------------------------ results
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule,
+            path=self.relpath,
+            scope=self.scope_name(node),
+            line=getattr(node, "lineno", 0),
+            message=message,
+        )
+
+
+class Rule:
+    """A single pass.  Subclasses set ``name``/``description`` and
+    implement :meth:`check` over a shared :class:`FileContext`."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        raise NotImplementedError
+
+
+def iter_file_contexts(
+    paths: Iterable[str], base: str | None = None
+) -> Iterator[FileContext]:
+    """Parse every ``.py`` under ``paths`` exactly once.  ``relpath`` is
+    computed against ``base`` (default: each root's parent directory, the
+    donation_lint convention — so package files key as
+    ``distributed_learning_simulator_tpu/...``)."""
+    for root in paths:
+        root = os.path.abspath(root)
+        rel_base = base or os.path.dirname(root)
+        if os.path.isfile(root):
+            files = [root]
+        else:
+            files = []
+            for dirpath, _dirs, names in os.walk(root):
+                for name in sorted(names):
+                    if name.endswith(".py"):
+                        files.append(os.path.join(dirpath, name))
+        for path in files:
+            with open(path, encoding="utf8") as f:
+                source = f.read()
+            relpath = os.path.relpath(path, rel_base).replace(os.sep, "/")
+            yield FileContext(path, relpath, source)
+
+
+def run_rules(
+    paths: Iterable[str],
+    rules: Iterable[Rule],
+    base: str | None = None,
+) -> list[Finding]:
+    """Run every rule over every file (one parse per file), findings
+    sorted by key then line."""
+    rules = list(rules)
+    findings: list[Finding] = []
+    for ctx in iter_file_contexts(paths, base=base):
+        for rule in rules:
+            findings.extend(rule.check(ctx))
+    return sorted(findings, key=lambda f: (f.key, f.line))

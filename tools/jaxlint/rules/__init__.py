@@ -1,0 +1,27 @@
+"""Rule registry: name -> Rule class, in documentation order."""
+
+from .host_sync import HostSyncInHotLoop
+from .retrace import RetraceHazard
+from .rng_split import RngSplitCountDiscipline
+from .use_after_donate import UseAfterDonate
+from .zero_copy import ZeroCopyView
+
+RULES = {
+    rule.name: rule
+    for rule in (
+        UseAfterDonate,
+        HostSyncInHotLoop,
+        RngSplitCountDiscipline,
+        RetraceHazard,
+        ZeroCopyView,
+    )
+}
+
+__all__ = [
+    "RULES",
+    "UseAfterDonate",
+    "HostSyncInHotLoop",
+    "RngSplitCountDiscipline",
+    "RetraceHazard",
+    "ZeroCopyView",
+]

@@ -1,0 +1,128 @@
+"""host-sync-in-hot-loop: blocking device→host transfers inside the round
+/ horizon loops and scan bodies.
+
+The sessions' ``run()`` paths carry explicit dispatch budgets
+(``dispatch_count`` / ``host_sync_count``, guarded by bench.py): one jitted
+dispatch and one host sync per round (or per horizon).  A stray
+``.item()`` / ``float(arr)`` / ``np.asarray`` / ``jax.device_get`` /
+``block_until_ready`` inside the loop serializes the host against the
+device and silently wrecks the budget; inside a ``lax.scan`` body it is a
+trace-time error at best and a hidden constant at worst.
+
+Hot contexts:
+
+* ``for``/``while`` bodies inside functions named ``run`` / ``_run*``
+  (the session run paths);
+* the body of any function passed to ``jax.lax.scan`` (by name or as an
+  inline lambda).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..engine import FileContext, Finding, Rule, dotted_name
+
+HOT_FUNC_RE = re.compile(r"^(run|_run\w*)$")
+
+#: dotted call names that force a device→host sync
+SYNC_DOTTED = {
+    "jax.device_get",
+    "device_get",
+    "jax.block_until_ready",
+    "np.asarray",
+    "numpy.asarray",
+}
+
+#: method calls on an array that force a sync
+SYNC_METHODS = {"item", "block_until_ready"}
+
+_SCAN_NAMES = ("jax.lax.scan", "lax.scan", "scan")
+
+
+def _scan_bodies(ctx: FileContext) -> set[ast.AST]:
+    """Function defs / lambdas passed as the first argument to
+    ``jax.lax.scan`` in this file."""
+    body_names: set[str] = set()
+    bodies: set[ast.AST] = set()
+    for call in ctx.calls():
+        if dotted_name(call.func) not in _SCAN_NAMES or not call.args:
+            continue
+        first = call.args[0]
+        if isinstance(first, ast.Lambda):
+            bodies.add(first)
+        elif isinstance(first, ast.Name):
+            body_names.add(first.id)
+    if body_names:
+        for func in ctx.functions():
+            if func.name in body_names:
+                bodies.add(func)
+    return bodies
+
+
+class HostSyncInHotLoop(Rule):
+    name = "host-sync-in-hot-loop"
+    description = (
+        "blocking host syncs (.item(), float()/int() on arrays,"
+        " np.asarray, jax.device_get, block_until_ready) inside round/"
+        "horizon loops and lax.scan bodies"
+    )
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        scan_bodies = _scan_bodies(ctx)
+        findings: list[Finding] = []
+        for call in ctx.calls():
+            label = self._sync_label(call)
+            if label is None:
+                continue
+            ctx_label = self._hot_context(ctx, call, scan_bodies)
+            if ctx_label is None:
+                continue
+            findings.append(
+                ctx.finding(
+                    self.name,
+                    call,
+                    f"{label} inside {ctx_label} — serializes the host"
+                    " against the device and breaks the session's"
+                    " dispatch/host-sync budget",
+                )
+            )
+        return findings
+
+    @staticmethod
+    def _sync_label(call: ast.Call) -> str | None:
+        name = dotted_name(call.func)
+        if name in SYNC_DOTTED:
+            return f"`{name}`"
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in SYNC_METHODS
+            and not call.args
+        ):
+            return f"`.{call.func.attr}()`"
+        if name in ("float", "int") and len(call.args) == 1:
+            arg = call.args[0]
+            if isinstance(arg, ast.Constant):
+                return None
+            if isinstance(arg, ast.Call) and dotted_name(arg.func) == "len":
+                return None  # len() is host-side already
+            return f"`{name}()` on a non-literal"
+        return None
+
+    def _hot_context(
+        self, ctx: FileContext, call: ast.Call, scan_bodies: set[ast.AST]
+    ) -> str | None:
+        in_loop = False
+        for anc in ctx.ancestors(call):
+            if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+                in_loop = True
+            if anc in scan_bodies:
+                return "a lax.scan body"
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if in_loop and HOT_FUNC_RE.match(anc.name):
+                    return f"the `{anc.name}()` round loop"
+                # the innermost def decides hotness; loops in a nested
+                # helper belong to that helper's own scope
+                return None
+        return None

@@ -1,0 +1,178 @@
+"""retrace-hazard: jitted callables fed arguments that defeat the trace
+cache, and trace-time constants materialized inside jitted bodies.
+
+Two shapes:
+
+* **call-site** — a call to a known-jitted callable passing (at a
+  non-static position) a python loop variable (retraces every iteration:
+  each int hashes to a fresh weak-typed constant) or a freshly
+  constructed ``list``/``dict`` literal (fresh container identity /
+  structure churn per call);
+* **body** — ``jnp.array(<python literal>)`` (or ``jnp.asarray``) inside
+  a jitted function body: the literal is re-materialized as an on-device
+  constant at every trace and hides host→device traffic in the program.
+
+Jitted callables/bodies are discovered per file: ``name = jax.jit(fn,
+…)`` assignments (incl. ``self.attr = …``), ``@jax.jit`` /
+``@functools.partial(jax.jit, …)`` decorated defs, defs passed to
+``jax.jit`` by name, and lambdas inlined into ``jax.jit(…)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import (
+    JIT_NAMES,
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    int_positions_kwarg,
+    is_jit_call,
+)
+
+_ARRAY_NAMES = ("jnp.array", "jnp.asarray")
+
+
+def _jit_call(call: ast.Call) -> bool:
+    return is_jit_call(call)
+
+
+def _static_positions(call: ast.Call) -> set[int]:
+    return set(int_positions_kwarg(call, "static_argnums", default=()))
+
+
+def _jitted(ctx: FileContext) -> tuple[dict[str, set[int]], set[ast.AST]]:
+    """(callee name -> static positions, jitted body defs/lambdas)."""
+    callees: dict[str, set[int]] = {}
+    body_names: dict[str, set[int]] = {}
+    bodies: set[ast.AST] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _jit_call(node):
+            static = _static_positions(node)
+            target = None
+            if dotted_name(node.func) in JIT_NAMES and node.args:
+                target = node.args[0]
+            elif len(node.args) > 1:  # partial(jax.jit, fn is unusual)
+                target = node.args[1]
+            if isinstance(target, ast.Lambda):
+                bodies.add(target)
+            elif isinstance(target, ast.Name):
+                body_names[target.id] = static
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _jit_call(node.value):
+                for tgt in node.targets:
+                    name = dotted_name(tgt)
+                    if name:
+                        callees[name] = _static_positions(node.value)
+    for func in ctx.functions():
+        if func.name in body_names:
+            bodies.add(func)
+            callees.setdefault(func.name, body_names[func.name])
+        for dec in func.decorator_list:
+            if (
+                isinstance(dec, (ast.Name, ast.Attribute))
+                and dotted_name(dec) in JIT_NAMES
+            ):
+                bodies.add(func)
+                callees.setdefault(func.name, set())
+            elif isinstance(dec, ast.Call) and _jit_call(dec):
+                bodies.add(func)
+                callees.setdefault(func.name, _static_positions(dec))
+    return callees, bodies
+
+
+def _loop_vars(ctx: FileContext, node: ast.AST) -> set[str]:
+    """Names bound as for-loop targets by loops enclosing ``node``."""
+    out: set[str] = set()
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.For, ast.AsyncFor)):
+            out.update(
+                n.id
+                for n in ast.walk(anc.target)
+                if isinstance(n, ast.Name)
+            )
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+    return out
+
+
+def _is_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(isinstance(e, ast.Constant) for e in node.elts)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.operand, ast.Constant):
+        return True
+    return False
+
+
+class RetraceHazard(Rule):
+    name = "retrace-hazard"
+    description = (
+        "jitted callables invoked with python loop variables or fresh"
+        " list/dict literals as non-static args, and jnp.array(<python"
+        " literal>) inside jitted bodies"
+    )
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        callees, bodies = _jitted(ctx)
+        findings: list[Finding] = []
+        for call in ctx.calls():
+            callee = dotted_name(call.func)
+            static = callees.get(callee)
+            if static is None and "." in callee:
+                static = callees.get(callee.rsplit(".", 1)[-1])
+            if static is None:
+                continue
+            loop_vars = None
+            for i, arg in enumerate(call.args):
+                if i in static:
+                    continue
+                if isinstance(arg, (ast.List, ast.Dict)):
+                    findings.append(
+                        ctx.finding(
+                            self.name,
+                            call,
+                            f"fresh container literal passed to jitted"
+                            f" `{callee}` at position {i} — construct it"
+                            " once outside the call (or mark the arg"
+                            " static)",
+                        )
+                    )
+                elif isinstance(arg, ast.Name):
+                    if loop_vars is None:
+                        loop_vars = _loop_vars(ctx, call)
+                    if arg.id in loop_vars:
+                        findings.append(
+                            ctx.finding(
+                                self.name,
+                                call,
+                                f"python loop variable `{arg.id}` passed"
+                                f" to jitted `{callee}` at position {i} —"
+                                " a fresh weak-typed constant every"
+                                " iteration retraces the program per"
+                                " round; pass a device array or mark the"
+                                " arg static",
+                            )
+                        )
+        for body in bodies:
+            for node in ast.walk(body):
+                if (
+                    isinstance(node, ast.Call)
+                    and dotted_name(node.func) in _ARRAY_NAMES
+                    and node.args
+                    and _is_literal(node.args[0])
+                ):
+                    findings.append(
+                        ctx.finding(
+                            self.name,
+                            node,
+                            f"`{dotted_name(node.func)}(<python literal>)`"
+                            " inside a jitted body — re-materialized as an"
+                            " on-device constant at every trace; hoist it"
+                            " or use jnp.full/zeros with a traced operand",
+                        )
+                    )
+        return findings

@@ -1,0 +1,80 @@
+"""rng-split-count-discipline: ``jax.random.split(key, n)`` where ``n``
+derives from a local slot/worker/client count.
+
+The PR 4 bug shape: on the non-partitionable threefry implementation,
+``split`` PREFIXES depend on the count — ``split(key, 4)[:2]`` !=
+``split(key, 2)``.  Any session that derives per-client streams from its
+*own* slot count silently forks trajectories from every other layout of
+the same run.  The canonical contract (``SpmdFedOBDSession._stream_slots``
+/ the PR 2 threaded-worker contract) is: split to the full-population
+default-mesh slot count, then take your rows.
+
+The rule flags ``split`` calls whose count expression mentions a
+slot/worker/client-shaped identifier, unless the expression already goes
+through the canonical ``*stream_slots`` name.  Count-free ``split(key)``
+and epoch/batch counts are out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..engine import FileContext, Finding, Rule, dotted_name
+
+_SPLIT_NAMES = ("jax.random.split", "random.split")
+
+#: identifiers that smell like a layout-dependent population count
+SUSPECT_RE = re.compile(r"slot|worker|client", re.IGNORECASE)
+
+#: the canonical full-population split contract — counts routed through it
+#: are layout-independent by construction
+CANONICAL_RE = re.compile(r"stream_slots")
+
+
+def _identifiers(node: ast.AST) -> list[str]:
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.append(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.append(sub.attr)
+    return out
+
+
+class RngSplitCountDiscipline(Rule):
+    name = "rng-split-count-discipline"
+    description = (
+        "jax.random.split counts derived from a local slot/worker count"
+        " instead of the canonical full-population contract"
+        " (_stream_slots) — split prefixes are count-dependent on"
+        " threefry"
+    )
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for call in ctx.calls():
+            if dotted_name(call.func) not in _SPLIT_NAMES:
+                continue
+            if len(call.args) < 2:
+                continue  # count-free split: no prefix hazard
+            count = call.args[1]
+            idents = _identifiers(count)
+            if any(CANONICAL_RE.search(i) for i in idents):
+                continue
+            suspects = sorted({i for i in idents if SUSPECT_RE.search(i)})
+            if not suspects:
+                continue
+            findings.append(
+                ctx.finding(
+                    self.name,
+                    call,
+                    "jax.random.split count derives from"
+                    f" {', '.join(f'`{s}`' for s in suspects)} — split"
+                    " prefixes are count-dependent on threefry, so a"
+                    " layout-local count silently forks trajectories;"
+                    " split to the canonical full-population count"
+                    " (_stream_slots) and take rows",
+                )
+            )
+        return findings

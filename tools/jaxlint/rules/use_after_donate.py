@@ -1,0 +1,235 @@
+"""use-after-donate: values reaching a donated jit argument that are read
+again afterward, plus the unwrapped-``jax.device_put`` check migrated from
+``tools/donation_lint.py`` as a sub-rule.
+
+The hazard class (PR 2's ``_place_params`` NaN/segfault): a donated
+argument's buffer is reused by XLA the moment the program runs — any
+later host-side read of the python value sees freed/overwritten memory.
+Two statically checkable shapes, emitted under DISTINCT rule keys so an
+audit of one never mutes the other in the same scope:
+
+* **dataflow** (``use-after-donate``) — a name passed at a donated
+  position of a known-donated callable (``jax.jit(...,
+  donate_argnums=...)`` assignments and decorated defs in the same file,
+  plus the package-wide known donated entry points below) is read again
+  later in the same function without an intervening rebind.  A donation
+  inside a loop taints the whole loop body: a read textually ABOVE the
+  donating call still executes after it on the next iteration.
+* **device-put** (``use-after-donate/device-put``) — ``jax.device_put``
+  of host numpy can return a zero-copy view of the python-owned buffer
+  on the cpu backend; if the result ever feeds a donated argument, XLA
+  writes through the python heap.  Every ``device_put`` whose own
+  expression does not copy is reported for audit (the donation_lint
+  contract, unchanged).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import (
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    int_positions_kwarg,
+    is_jit_call,
+)
+
+#: package-wide donated entry points (callable by bare name from any
+#: file): ``ops/pytree.py::flat_acc_add`` donates its accumulator; the
+#: ``parallel/spmd*.py`` round/horizon programs are jitted locally and
+#: picked up by the per-file scan below.
+KNOWN_DONATED_ENTRY_POINTS: dict[str, tuple[int, ...]] = {
+    "flat_acc_add": (0,),
+}
+
+#: the device-put sub-rule's finding key suffix — distinct from the
+#: dataflow key so one allowlist audit cannot cover both sub-rules
+DEVICE_PUT_RULE = "use-after-donate/device-put"
+
+
+def jit_donate_positions(call: ast.Call) -> tuple[int, ...] | None:
+    """Donated positions if ``call`` is ``jax.jit(..., donate_argnums=…)``
+    or ``functools.partial(jax.jit, donate_argnums=…)``, else None."""
+    if not is_jit_call(call):
+        return None
+    return int_positions_kwarg(call, "donate_argnums", default=None)
+
+
+def _donated_callees(ctx: FileContext) -> dict[str, tuple[int, ...]]:
+    """``dotted-callee-name -> donated positions`` for this file: jit
+    assignments (``jitted = jax.jit(f, donate_argnums=…)``,
+    ``self._fn = jax.jit(…)``) and jit-decorated defs, merged over the
+    package-wide known entry points."""
+    callees = dict(KNOWN_DONATED_ENTRY_POINTS)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            pos = jit_donate_positions(node.value)
+            if pos is not None:
+                for tgt in node.targets:
+                    name = dotted_name(tgt)
+                    if name:
+                        callees[name] = pos
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    pos = jit_donate_positions(dec)
+                    if pos is not None:
+                        callees[node.name] = pos
+    return callees
+
+
+def _stmt_store_names(stmt: ast.stmt) -> set[str]:
+    return {
+        n.id
+        for n in ast.walk(stmt)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+    }
+
+
+def _enclosing_loop(ctx: FileContext, node: ast.AST) -> ast.AST | None:
+    """Innermost for/while enclosing ``node`` within the same function."""
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+            return anc
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return None
+    return None
+
+
+def _dataflow_findings(
+    ctx: FileContext, callees: dict[str, tuple[int, ...]]
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for func in ctx.functions():
+        owned = list(ctx.owned_nodes(func))
+        # name -> sorted store lines (rebinds clear the donated taint)
+        stores: dict[str, list[int]] = {}
+        reads: list[tuple[str, int]] = []
+        for node in owned:
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Store):
+                    stores.setdefault(node.id, []).append(node.lineno)
+                elif isinstance(node.ctx, ast.Load):
+                    reads.append((node.id, node.lineno))
+        seen_keys: set[tuple[str, int]] = set()
+        for node in owned:
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            pos = callees.get(callee)
+            if pos is None and "." in callee:
+                pos = callees.get(callee.rsplit(".", 1)[-1])
+            if pos is None:
+                continue
+            stmt = ctx.enclosing_statement(node)
+            if stmt is None:
+                continue
+            if isinstance(stmt, ast.Return):
+                continue  # control leaves the function with the donation
+            rebound = _stmt_store_names(stmt)
+            donate_line = getattr(stmt, "end_lineno", stmt.lineno)
+            # a donation inside a loop is re-executed: reads anywhere in
+            # the loop body run AFTER it on the next iteration, so the
+            # taint starts at the loop header, not the call line
+            loop = _enclosing_loop(ctx, node)
+            taint_from = loop.lineno if loop is not None else donate_line
+            for p in pos:
+                if p >= len(node.args):
+                    continue
+                arg = node.args[p]
+                if not isinstance(arg, ast.Name):
+                    continue
+                if arg.id in rebound:
+                    continue  # rebound by the very call statement
+                for rid, rline in reads:
+                    if rid != arg.id or rline <= taint_from:
+                        continue
+                    if stmt.lineno <= rline <= donate_line:
+                        continue  # the donating call's own argument read
+                    if any(
+                        taint_from <= s <= rline
+                        for s in stores.get(rid, ())
+                    ):
+                        continue
+                    if (arg.id, donate_line) in seen_keys:
+                        break
+                    seen_keys.add((arg.id, donate_line))
+                    findings.append(
+                        ctx.finding(
+                            UseAfterDonate.name,
+                            node,
+                            f"`{arg.id}` is donated to `{callee}` (arg"
+                            f" {p}, line {donate_line}) and read again at"
+                            f" line {rline}"
+                            + (
+                                " (loop-carried: the read re-executes"
+                                " after the donation)"
+                                if loop is not None and rline < donate_line
+                                else ""
+                            )
+                            + " — the buffer is reused by XLA the moment"
+                            " the program runs",
+                        )
+                    )
+                    break
+    return findings
+
+
+# ------------------------------------------------- device-put sub-rule
+def _is_copy_wrapper(call: ast.Call) -> bool:
+    """The call textually applies a copy to its inputs: ``jnp.copy(…)`` or
+    a tree map whose mapped function is ``…copy``."""
+    name = dotted_name(call.func)
+    if name.endswith(".copy") or name == "copy":
+        return True
+    if name in ("jax.tree.map", "jax.tree_util.tree_map", "tree.map") and call.args:
+        first = call.args[0]
+        first_name = (
+            dotted_name(first)
+            if isinstance(first, (ast.Attribute, ast.Name))
+            else ""
+        )
+        return first_name.endswith("copy")
+    return False
+
+
+def device_put_sites(ctx: FileContext) -> list[Finding]:
+    """Every ``jax.device_put`` call not wrapped in an intervening copy —
+    the exact donation_lint check, keyed ``use-after-donate/device-put``
+    (``tools/donation_lint.py`` shims onto this)."""
+    findings = []
+    for node in ctx.calls():
+        if dotted_name(node.func) not in ("jax.device_put", "device_put"):
+            continue
+        if any(
+            isinstance(anc, ast.Call) and _is_copy_wrapper(anc)
+            for anc in ctx.ancestors(node)
+        ):
+            continue
+        findings.append(
+            ctx.finding(
+                DEVICE_PUT_RULE,
+                node,
+                "jax.device_put without an intervening jnp.copy — on the"
+                " cpu backend this can alias the python-owned buffer; if"
+                " the result feeds a donated argument XLA writes through"
+                " the python heap",
+            )
+        )
+    return findings
+
+
+class UseAfterDonate(Rule):
+    name = "use-after-donate"
+    description = (
+        "values reaching a donated jit argument that are read again"
+        " afterward (incl. loop-carried reads), plus unwrapped"
+        " jax.device_put results keyed use-after-donate/device-put"
+        " (donation aliasing of python-owned buffers)"
+    )
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        callees = _donated_callees(ctx)
+        return _dataflow_findings(ctx, callees) + device_put_sites(ctx)

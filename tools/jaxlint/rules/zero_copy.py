@@ -1,0 +1,194 @@
+"""zero-copy-view: ``np.asarray`` / ``np.array(…, copy=False)`` whose
+result escapes the enclosing function.
+
+The PR 3 snapshot class: ``np.asarray`` of a replicated cpu device array
+is a zero-copy VIEW of the device buffer.  If that view outlives the
+call — returned, yielded, stored on ``self`` — and the source buffer is
+later donated into a round program, the "snapshot" mutates under the
+replay (the fedavg-parity "failure" that was really a corrupted
+baseline).  A view consumed immediately (reduced to a python scalar, fed
+to a fresh-array op) is safe and not flagged.
+
+Escape analysis (per enclosing function / lambda): the call or a name it
+is bound to reaches a ``return``/``yield`` through *aliasing-transparent*
+expressions only (subscripts, attributes, container displays,
+comprehensions — all of which can carry the view), or is stored to an
+attribute.  A ``Call``/arithmetic node on the path produces a fresh value
+and stops the escape.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Finding, Rule, dotted_name
+
+_ASARRAY = ("np.asarray", "numpy.asarray")
+_ARRAY = ("np.array", "numpy.array")
+
+#: nodes through which an array view flows unchanged
+_TRANSPARENT = (
+    ast.Subscript,
+    ast.Attribute,
+    ast.Tuple,
+    ast.List,
+    ast.Dict,
+    ast.Set,
+    ast.Starred,
+    ast.IfExp,
+    ast.NamedExpr,
+    ast.ListComp,
+    ast.SetComp,
+    ast.DictComp,
+    ast.GeneratorExp,
+    ast.comprehension,
+    ast.Index if hasattr(ast, "Index") else ast.Subscript,
+    ast.Slice,
+    ast.FormattedValue,
+    ast.keyword,
+)
+
+
+#: source expressions that are freshly constructed python objects — the
+#: asarray result may share THEIR buffer but can never alias a device
+#: array (list displays force a copy anyway)
+_FRESH_SOURCES = (
+    ast.List,
+    ast.Tuple,
+    ast.Set,
+    ast.Dict,
+    ast.ListComp,
+    ast.GeneratorExp,
+    ast.BinOp,
+    ast.Constant,
+)
+
+
+def _view_call(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if name in _ASARRAY:
+        pass
+    elif name in _ARRAY and any(
+        kw.arg == "copy"
+        and isinstance(kw.value, ast.Constant)
+        and kw.value.value is False
+        for kw in call.keywords
+    ):
+        pass
+    else:
+        return False
+    return not (call.args and isinstance(call.args[0], _FRESH_SOURCES))
+
+
+class ZeroCopyView(Rule):
+    name = "zero-copy-view"
+    description = (
+        "np.asarray / np.array(copy=False) results escaping the"
+        " enclosing function — zero-copy views of device buffers mutate"
+        " when the source is donated"
+    )
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for call in ctx.calls():
+            if not _view_call(call):
+                continue
+            how = self._escapes(ctx, call)
+            if how is None:
+                continue
+            findings.append(
+                ctx.finding(
+                    self.name,
+                    call,
+                    f"`{dotted_name(call.func)}` view {how} — on the cpu"
+                    " backend this aliases the source buffer and mutates"
+                    " if it is later donated; take a real copy"
+                    " (np.array(..., copy=True) / jnp.copy) or audit",
+                )
+            )
+        return findings
+
+    # ------------------------------------------------------------ escape
+    def _escapes(self, ctx: FileContext, call: ast.Call) -> str | None:
+        func = ctx.enclosing_callable(call)
+        if func is None:
+            return None  # module level: a constant, not a round-path view
+        if isinstance(func, ast.Lambda):
+            if self._transparent_path(ctx, call, func.body):
+                return "is the lambda's return value"
+            return None
+        # 1. value flows into a return/yield directly
+        for node in ast.walk(func):
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if node.value is not None and self._transparent_path(
+                    ctx, call, node.value
+                ):
+                    return "escapes via return/yield"
+        stmt = ctx.enclosing_statement(call)
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            return None
+        value = stmt.value
+        if value is None or not self._transparent_path(ctx, call, value):
+            return None  # consumed before binding (e.g. .astype() copies)
+        targets = (
+            stmt.targets
+            if isinstance(stmt, ast.Assign)
+            else [stmt.target]
+        )
+        names: set[str] = set()
+        for tgt in targets:
+            if isinstance(tgt, ast.Attribute):
+                return f"is stored to `{dotted_name(tgt)}`"
+            names.update(
+                n.id
+                for n in ast.walk(tgt)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+            )
+        if not names:
+            return None
+        # 2. a bound name reaches a return/yield transparently or is
+        #    stored to an attribute later
+        for node in ast.walk(func):
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if node.value is None:
+                    continue
+                for sub in ast.walk(node.value):
+                    if (
+                        isinstance(sub, ast.Name)
+                        and sub.id in names
+                        and self._transparent_path(ctx, sub, node.value)
+                    ):
+                        return f"(via `{sub.id}`) escapes via return/yield"
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and any(
+                        isinstance(n, ast.Name)
+                        and n.id in names
+                        and self._transparent_path(ctx, n, node.value)
+                        for n in ast.walk(node.value)
+                    ):
+                        return (
+                            f"(via {sorted(names)}) is stored to"
+                            f" `{dotted_name(tgt)}`"
+                        )
+        return None
+
+    def _transparent_path(
+        self, ctx: FileContext, node: ast.AST, root: ast.AST
+    ) -> bool:
+        """True if ``node`` is ``root`` or reaches it through
+        aliasing-transparent expressions only (no Call/arithmetic that
+        would produce a fresh value)."""
+        if node is root:
+            return True
+        cur = ctx.parents.get(node)
+        while cur is not None:
+            if not isinstance(cur, _TRANSPARENT):
+                # a consuming node anywhere on the path — including as the
+                # binding root itself (``np.asarray(x).copy()``) — yields
+                # a fresh value, not the view
+                return False
+            if cur is root:
+                return True
+            cur = ctx.parents.get(cur)
+        return False
