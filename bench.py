@@ -752,6 +752,74 @@ def measure_long_context() -> dict:
     return out
 
 
+# fault-tolerance A/B: the dropout availability mask is folded into the
+# host-built selection weight rows (parallel/spmd.py), so a masked round
+# must cost ~the same wall time as an unmasked one — no new device inputs,
+# dispatches, or host syncs.  Measures full session.run() loops with and
+# without a seeded FaultPlan dropout schedule and reports
+# dropout_overhead_fraction = masked/unmasked wall time − 1 (≈0 is the
+# design goal; large positive values mean the mask grew a host-side cost).
+FT_WORKERS = 8
+FT_ROUNDS = 4
+FT_BATCH = 32
+FT_DROPOUT_RATE = 0.25
+
+
+def measure_fault_tolerance() -> dict:
+    from distributed_learning_simulator_tpu.parallel.spmd import (
+        SpmdFedAvgSession,
+    )
+    from distributed_learning_simulator_tpu.training import _build_task
+
+    out: dict = {
+        "model": "LeNet5/MNIST",
+        "workers": FT_WORKERS,
+        "rounds": FT_ROUNDS,
+        "dropout_rate": FT_DROPOUT_RATE,
+    }
+    for arm, fault_tolerance in (
+        ("unmasked", {}),
+        ("masked", {"dropout_rate": FT_DROPOUT_RATE, "seed": 1}),
+    ):
+        config = make_config(
+            "spmd",
+            FT_WORKERS,
+            FT_WORKERS * FT_BATCH,
+            model_name="LeNet5",
+            batch_size=FT_BATCH,
+            tag=f"ft_{arm}",
+            dataset_name="MNIST",
+            rounds=FT_ROUNDS,
+            use_amp=False,  # the canonical LeNet5/MNIST config is fp32
+            fault_tolerance=fault_tolerance,
+        )
+        ctx = _build_task(config)
+        session = SpmdFedAvgSession(
+            ctx.config,
+            ctx.dataset_collection,
+            ctx.model_ctx,
+            ctx.engine,
+            ctx.practitioners,
+        )
+        session.run()  # warmup: compiles the round program
+        session._stat.clear()
+        session.reset_dispatch_stats()
+        start = time.monotonic()
+        session.run()
+        elapsed = time.monotonic() - start
+        out[arm] = {
+            "rounds_per_sec": round(FT_ROUNDS / elapsed, 4),
+            "seconds_per_round": round(elapsed / FT_ROUNDS, 6),
+            "dispatches_per_round": round(session.dispatches_per_round, 4),
+            "host_sync_points": round(session.host_sync_points, 4),
+        }
+    masked = out["masked"]["seconds_per_round"]
+    unmasked = out["unmasked"]["seconds_per_round"]
+    if unmasked > 0:
+        out["dropout_overhead_fraction"] = round(masked / unmasked - 1.0, 4)
+    return out
+
+
 def measure_lint() -> int:
     """Total jaxlint findings (audited included) from ``python -m
     tools.jaxlint --format json`` — the analyzer-health count the bench
@@ -822,6 +890,16 @@ def main() -> None:
     except Exception as exc:
         obd_fusion = {"error": str(exc)[:200]}
     obd_fused = obd_fusion.get(f"gather_h{OBD_HORIZON}", {})
+    # fault-tolerance A/B: masked (FaultPlan dropout) vs unmasked round
+    # wall time — the availability mask must be free (it rides the weight
+    # rows the rounds already consume)
+    try:
+        fault_tolerance = measure_fault_tolerance()
+    except Exception as exc:
+        fault_tolerance = {"error": str(exc)[:200]}
+    # the -1/absent-never contract: the top-level field always prints; -1
+    # means the measurement failed (same convention as lint_findings)
+    dropout_overhead = fault_tolerance.get("dropout_overhead_fraction", -1.0)
     # analyzer health: total jaxlint findings over the package (every one
     # audited in tools/jaxlint/allowlist.txt — un-audited findings fail
     # tier-1, so this counts the standing audited-hazard surface)
@@ -907,6 +985,12 @@ def main() -> None:
                     "speedup": obd_fusion.get("speedup", 0.0),
                 },
                 "obd_fusion": obd_fusion,
+                # fault tolerance: masked-vs-unmasked round wall time
+                # (dropout_overhead_fraction ≈ 0 is the design goal; -1 =
+                # the measurement failed, the field itself never goes
+                # missing)
+                "dropout_overhead_fraction": dropout_overhead,
+                "fault_tolerance": fault_tolerance,
                 "lint_findings": lint_findings,
                 "canonical": canonical,
             }

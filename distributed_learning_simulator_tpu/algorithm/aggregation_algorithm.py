@@ -22,18 +22,34 @@ class AggregationAlgorithm:
         self._server = server
         self._all_worker_data: dict[int, Message] = {}
         self._skipped_workers: set[int] = set()
+        self._rejected_workers: set[int] = set()
         self._old_parameter_dict: Params | None = None
         self._config = None
+        self._fault_plan = None
 
     def set_server(self, server) -> None:
         self._server = server
 
     def set_config(self, config) -> None:
         self._config = config
+        from ..util.faults import FaultPlan
+
+        self._fault_plan = (
+            FaultPlan.from_config(config) if config is not None else None
+        )
 
     @property
     def all_worker_data(self) -> dict[int, Message]:
         return self._all_worker_data
+
+    @property
+    def skipped_workers(self) -> set[int]:
+        return self._skipped_workers
+
+    @property
+    def rejected_workers(self) -> set[int]:
+        """Workers whose uploads the update guard rejected this round."""
+        return self._rejected_workers
 
     @staticmethod
     def get_ratios(
@@ -99,7 +115,56 @@ class AggregationAlgorithm:
                     worker_data.complete(self._old_parameter_dict)
             case Message():
                 pass
+        if isinstance(
+            worker_data, ParameterMessage
+        ) and not self._update_passes_guard(worker_id, worker_data):
+            # update hygiene (fault_tolerance.update_guard): a non-finite
+            # or norm-exploded upload is counted and demoted to a skipped
+            # worker BEFORE any accumulation can see it — the round
+            # renormalizes over the survivors (same semantics as the SPMD
+            # sessions' in-program guard)
+            self._rejected_workers.add(worker_id)
+            self._skipped_workers.add(worker_id)
+            return
         self._all_worker_data[worker_id] = worker_data
+
+    def _update_passes_guard(
+        self, worker_id: int, message: ParameterMessage
+    ) -> bool:
+        plan = self._fault_plan
+        if plan is None or not plan.update_guard:
+            return True
+        import numpy as np
+
+        norm_sq = 0.0
+        for name, value in message.parameter.items():
+            arr = np.asarray(value, np.float32)
+            if not np.all(np.isfinite(arr)):
+                get_logger().warning(
+                    "update guard: worker %s upload %r is non-finite — "
+                    "rejected",
+                    worker_id,
+                    name,
+                )
+                return False
+            if plan.max_update_norm > 0 and self._old_parameter_dict:
+                old = self._old_parameter_dict.get(name)
+                if old is not None:
+                    norm_sq += float(
+                        np.sum(
+                            np.square(arr - np.asarray(old, np.float32))
+                        )
+                    )
+        if plan.max_update_norm > 0 and norm_sq > plan.max_update_norm**2:
+            get_logger().warning(
+                "update guard: worker %s delta norm %.3e exceeds "
+                "max_update_norm=%.3e — rejected",
+                worker_id,
+                norm_sq**0.5,
+                plan.max_update_norm,
+            )
+            return False
+        return True
 
     def aggregate_worker_data(self) -> Message:
         raise NotImplementedError
@@ -107,6 +172,7 @@ class AggregationAlgorithm:
     def clear_worker_data(self) -> None:
         self._all_worker_data.clear()
         self._skipped_workers.clear()
+        self._rejected_workers.clear()
 
     def exit(self) -> None:
         pass

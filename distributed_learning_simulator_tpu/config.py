@@ -83,6 +83,19 @@ class DistributedTrainingConfig:
     # task when NO message moves for this many seconds (0 = disabled; size
     # it well above the longest per-round local training time)
     watchdog_seconds: float = 0.0
+    # fault-tolerance layer (util/faults.py::FaultPlan): seeded client
+    # dropout / straggler / corrupt-update / process-kill injection, the
+    # device-side update guard (update_guard / max_update_norm), threaded
+    # worker-fault demotion (client_faults_nonfatal), and the
+    # train_with_recovery retry budget (max_restarts /
+    # restart_backoff_seconds).  Empty = no failure model, bit-exact
+    # legacy behavior.  algorithm_kwargs.min_client_quorum gates how few
+    # survivors a round may aggregate over.
+    fault_tolerance: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # multi-host bring-up: retry jax.distributed.initialize this many times
+    # with exponential backoff before raising a diagnostic naming the
+    # unreachable coordinator (parallel/mesh.py::initialize_multihost)
+    multihost_init_retries: int = 0
 
     def load_config_and_process(self, overrides: dict[str, Any] | None = None) -> None:
         """Derive ``save_dir``/``log_file`` the way the reference does

@@ -40,6 +40,9 @@ def initialize_multihost(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    retries: int | None = None,
+    backoff_seconds: float = 1.0,
+    config=None,
 ) -> None:
     """Join a multi-host pod (DCN between hosts, ICI within).
 
@@ -49,7 +52,21 @@ def initialize_multihost(
     ``make_mesh`` spans the pod.  This is the framework's analogue of the
     reference's NCCL/MPI bring-up, except the reference never had one (its
     backend is single-host pipes — SURVEY.md §5): collectives ride ICI/DCN
-    via the mesh, not a side channel.  Idempotent."""
+    via the mesh, not a side channel.  Idempotent.
+
+    ``retries`` (``config.multihost_init_retries``) re-attempts a failed
+    join with exponential backoff (``backoff_seconds`` × 2^attempt) before
+    giving up: pod bring-up is racy by nature — the coordinator host often
+    starts seconds after its workers, and preempted hosts rejoin a
+    coordinator that is itself still restarting.  The terminal error names
+    the unreachable coordinator instead of surfacing a bare connect error
+    with no address to debug."""
+    if retries is None:
+        retries = (
+            int(getattr(config, "multihost_init_retries", 0) or 0)
+            if config is not None
+            else 0
+        )
     # NOT jax.process_count(): that would touch the backend, and
     # jax.distributed.initialize() must run before backend init.
     # ``is_initialized`` does not exist on every jax version — fall back to
@@ -62,23 +79,50 @@ def initialize_multihost(
         state = getattr(jax.distributed, "global_state", None)
         if state is not None and getattr(state, "client", None) is not None:
             return  # already joined
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
-    except (ValueError, RuntimeError):
-        if (
-            coordinator_address is not None
-            or num_processes is not None
-            or process_id is not None
-        ):
-            # the caller asked for a specific cluster — failing to join it
-            # is an error, not a single-process fallback
-            raise
-        # bare call with no coordinator configured: single-process run
-        pass
+    explicit_cluster = (
+        coordinator_address is not None
+        or num_processes is not None
+        or process_id is not None
+    )
+    last_error: Exception | None = None
+    for attempt in range(max(0, int(retries)) + 1):
+        if attempt:
+            import time
+
+            delay = backoff_seconds * (2 ** (attempt - 1))
+            from ..utils.logging import get_logger
+
+            get_logger().warning(
+                "initialize_multihost: join attempt %d/%d failed (%s); "
+                "retrying in %.1fs",
+                attempt,
+                retries + 1,
+                last_error,
+                delay,
+            )
+            time.sleep(delay)
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+            return
+        except (ValueError, RuntimeError) as exc:
+            last_error = exc
+            if not explicit_cluster:
+                # bare call with no coordinator configured: single-process
+                # run — no cluster to retry against
+                return
+    raise RuntimeError(
+        "initialize_multihost: coordinator "
+        f"{coordinator_address or '<auto-detected>'} unreachable after "
+        f"{retries + 1} attempt(s) "
+        f"(num_processes={num_processes}, process_id={process_id}); "
+        "check that the coordinator host is up and the address/port is "
+        "routable from this host, or raise config.multihost_init_retries "
+        f"for racier bring-ups. Last error: {last_error}"
+    ) from last_error
 
 
 def put_sharded(host_data, sharding):

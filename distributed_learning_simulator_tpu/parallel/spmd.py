@@ -229,6 +229,56 @@ def scan_local_epochs_carry(
     )
 
 
+def guard_client_update(params, global_params, weight, summed, max_update_norm):
+    """THE device-side update-hygiene check, shared by the FedAvg round
+    program and the OBD phase programs (one definition — the guard
+    semantics must never drift between methods): reject a client whose
+    round delta (``params − global_params``, leaf-paired) is non-finite or
+    norm-exploded, or whose aggregation weight arrived poisoned (the
+    FaultPlan corrupt-injection channel).  Returns ``(eff_weight,
+    summed')`` — the rejected slot's effective weight is exactly zero, and
+    the per-slot reject flag plus the effective weight ride the metrics
+    tree (``_eff_weight`` is popped by the shard bodies to form the
+    survivor-renormalized total weight)."""
+    finite = jnp.bool_(True)
+    norm_sq = jnp.float32(0.0)
+    for p, g in zip(
+        jax.tree.leaves(params), jax.tree.leaves(global_params)
+    ):
+        delta = p.astype(jnp.float32) - g.astype(jnp.float32)
+        finite = finite & jnp.all(jnp.isfinite(delta))
+        norm_sq = norm_sq + jnp.sum(jnp.square(delta))
+    ok = finite & jnp.isfinite(weight)
+    if max_update_norm > 0:
+        ok = ok & (norm_sq <= jnp.float32(max_update_norm) ** 2)
+    participating = (weight != 0).astype(jnp.float32)  # NaN != 0
+    eff_weight = jnp.where(ok, weight, jnp.float32(0.0))
+    summed = dict(
+        summed,
+        rejected_updates=jnp.where(ok, 0.0, participating),
+        _eff_weight=eff_weight,
+    )
+    return eff_weight, summed
+
+
+def guarded_average(global_sum, total_weight, params_in):
+    """Survivor-renormalized average for guard-compiled programs: with at
+    least one surviving weight this is the plain weighted average; with
+    ZERO survivors (every upload rejected) the round keeps the OLD global
+    params — dividing an all-zero sum by the epsilon floor would silently
+    replace the trained model with zeros.  The host-side post-guard quorum
+    check aborts such a round loudly right after the fetch."""
+    return jax.tree.map(
+        lambda s, old: jnp.where(
+            total_weight > 0,
+            (s / jnp.maximum(total_weight, 1e-12)).astype(old.dtype),
+            old,
+        ),
+        global_sum,
+        params_in,
+    )
+
+
 def whole_mesh_session_shapes(session):
     """Trace-time (params, metrics) shape templates for sessions that give
     the WHOLE mesh to one client at a time (sequence-parallel, expert-
@@ -424,6 +474,38 @@ class SpmdFedAvgSession:
             if self._selection_gather
             else self.n_slots
         )
+        # ---- fault tolerance (util/faults.py) ----
+        # The availability mask rides the SAME host-built weight rows
+        # selection does (a dropped client's weight is zeroed, a corrupt
+        # one's is NaN'd, in _select_weights/_select_indices) — the jitted
+        # round programs are untouched, so an empty fault_tolerance config
+        # is bit-exact and zero-overhead, and the mask composes with the
+        # gather ([S_pad] rows) and fused-horizon ([H, S_pad] matrices)
+        # machinery for free.  The update guard IS a program change
+        # (per-client delta hygiene + survivor-renormalized total weight),
+        # gated at trace time by ``self._update_guard``.
+        from ..util.faults import FaultPlan
+
+        self._fault_plan = FaultPlan.from_config(config)
+        self._min_quorum = int(
+            config.algorithm_kwargs.get("min_client_quorum", 0) or 0
+        )
+        self._update_guard = bool(
+            self._fault_plan is not None and self._fault_plan.update_guard
+        )
+        self._max_update_norm = (
+            self._fault_plan.max_update_norm if self._fault_plan else 0.0
+        )
+        if self._update_guard:
+            guard_reason = self._update_guard_unsupported_reason()
+            if guard_reason is not None:
+                raise ValueError(
+                    "fault_tolerance.update_guard is unsupported here: "
+                    f"{guard_reason} — drop the knob for this session"
+                )
+        #: earliest FaultPlan kill round reached but not yet fired —
+        #: kills only fire once the killed round is durably resumable
+        self._kill_armed_round: int | None = None
         # round-horizon fusion (``algorithm_kwargs.round_horizon``): fuse H
         # consecutive rounds into ONE jitted, donated ``lax.scan`` over
         # rounds, with per-round test evaluation in-program — the host
@@ -589,6 +671,65 @@ class SpmdFedAvgSession:
         loops (FedOBD) override this."""
         return self._round_program_fn is not None
 
+    def _update_guard_unsupported_reason(self) -> str | None:
+        """Why this session cannot compile the device-side update guard
+        into its round program (None = supported).  Sessions that extend
+        the guard to their own round programs (FedOBD) override this."""
+        if type(self) is not SpmdFedAvgSession:
+            return f"{type(self).__name__} builds its own round program"
+        return None
+
+    def _maybe_kill(self, first_round: int, last_round: int | None = None) -> None:
+        """Arm any FaultPlan-scheduled process kill in the (inclusive)
+        round range, and fire the earliest armed kill only once the
+        killed round is DURABLY resumable — its checkpoint written
+        (``_last_ckpt_round``) and its record rows flushed.  The plan is
+        stateless on the premise that a resumed run starts past the
+        killed round and never re-trips the same kill; that only holds if
+        the kill waits for a checkpoint ≥ its round, so sparse
+        ``checkpoint_every`` / horizon cadences simply DEFER the kill to
+        the next durable boundary (the final round always checkpoints and
+        flushes, so an armed kill fires by run end).  Called inside the
+        ``with self._ckpt:`` block — the raise drains the writer."""
+        plan = self._fault_plan
+        if plan is None:
+            return
+        last = first_round if last_round is None else last_round
+        self._kill_armed_round = plan.arm_kill(
+            first_round, last, self._kill_armed_round
+        )
+        plan.fire_armed_kill(
+            self._kill_armed_round,
+            self._last_ckpt_round,
+            record_durable=not self._record_dirty,
+        )
+
+    def _post_guard_quorum(
+        self, round_number: int, participating, rejected
+    ) -> None:
+        """The quorum semantics guard-active rounds document (migrating.md
+        "Fault tolerance"): survivors = uploads that reached aggregation −
+        guard-rejected, with a floor of 1.  A fully-rejected round already
+        kept the OLD params in-program (``guarded_average``) — this
+        surfaces it as a loud abort instead of a silent no-op round.  The
+        counts arrive host-side with the round's one metric sync, so the
+        check costs nothing extra."""
+        if not self._update_guard:
+            return
+        survivors = int(participating) - int(rejected)
+        quorum = max(self._min_quorum, 1)
+        if survivors < quorum:
+            from ..util.faults import QuorumLostError
+
+            message = (
+                f"round {round_number}: {survivors} surviving uploads after "
+                f"update-guard rejections ({int(rejected)} rejected of "
+                f"{int(participating)}) below min_client_quorum={quorum} — "
+                "aborting loudly (the round kept the previous params)"
+            )
+            get_logger().error(message)
+            raise QuorumLostError(message)
+
     def _leaf_spec(self, shape, name: str = "") -> P:
         """FSDP layout rule: shard a param leaf's leading dim over the
         ``model`` axis when it divides evenly, else keep it replicated."""
@@ -629,6 +770,8 @@ class SpmdFedAvgSession:
         engine = self.engine
         epochs = self.config.epoch
         quant_level = self.quantization_level
+        guard_active = self._update_guard
+        max_update_norm = self._max_update_norm
 
         def local_train(global_params, data, weight, rng, val=None):
             """One client slot's round contribution."""
@@ -647,6 +790,13 @@ class SpmdFedAvgSession:
                     for p, g, k in zip(leaves, g_leaves, keys)
                 ]
                 params = jax.tree.unflatten(treedef, leaves)
+            if guard_active:
+                # update hygiene (fault_tolerance.update_guard): the
+                # shared guard rejects non-finite / norm-exploded deltas
+                # and poisoned weights BEFORE the weighted reduction
+                weight, summed = guard_client_update(
+                    params, global_params, weight, summed, max_update_norm
+                )
             # weighted contribution; unselected slots contribute zero
             contribution = jax.tree.map(
                 lambda p: p.astype(jnp.float32) * weight, params
@@ -760,12 +910,30 @@ class SpmdFedAvgSession:
                 global_sum = {
                     k: reduce_leaf(k, s) for k, s in local_sum.items()
                 }
-                total_weight = jax.lax.psum(jnp.sum(weights), axis_name=slot_axes)
-                new_global = jax.tree.map(
-                    lambda s, g: (s / jnp.maximum(total_weight, 1e-12)).astype(g.dtype),
-                    global_sum,
-                    params_in,
-                )
+                if guard_active:
+                    # survivor renormalization: the total is the sum of the
+                    # guard's EFFECTIVE weights (rejected slots at exactly
+                    # zero), carried per-slot through the metrics tree; a
+                    # zero-survivor round keeps the old params instead of
+                    # zeroing the model
+                    metrics = dict(metrics)
+                    total_weight = jax.lax.psum(
+                        metrics.pop("_eff_weight"), axis_name=slot_axes
+                    )
+                    new_global = guarded_average(
+                        global_sum, total_weight, params_in
+                    )
+                else:
+                    total_weight = jax.lax.psum(
+                        jnp.sum(weights), axis_name=slot_axes
+                    )
+                    new_global = jax.tree.map(
+                        lambda s, g: (
+                            s / jnp.maximum(total_weight, 1e-12)
+                        ).astype(g.dtype),
+                        global_sum,
+                        params_in,
+                    )
                 metrics = jax.tree.map(
                     lambda m: jax.lax.psum(jnp.sum(m), axis_name=slot_axes),
                     metrics,
@@ -945,6 +1113,7 @@ class SpmdFedAvgSession:
 
     # ------------------------------------------------------------------
     def _select_weights(self, round_number: int) -> np.ndarray:
+        from ..util.faults import apply_fault_plan
         from ..utils.selection import select_workers
 
         selected = select_workers(
@@ -956,7 +1125,17 @@ class SpmdFedAvgSession:
         weights = np.zeros(self.n_slots, np.float32)
         for worker_id in selected:
             weights[worker_id] = self._dataset_sizes[worker_id]
-        return weights
+        # fold the round's availability mask into the weight row (dropped
+        # → 0, corrupt → NaN) and enforce the quorum — a no-op without a
+        # fault plan, so the unfaulted trajectory is bit-exact
+        return apply_fault_plan(
+            self._fault_plan,
+            self._min_quorum,
+            round_number,
+            None,
+            weights,
+            self.config.worker_number,
+        )
 
     def _select_indices(
         self, round_number: int
@@ -966,6 +1145,7 @@ class SpmdFedAvgSession:
         weighted reduction sees the contributions in the same order) padded
         to the static ``s_pad`` with id 0 at weight 0, plus their
         aggregation weights."""
+        from ..util.faults import apply_fault_plan
         from ..utils.selection import select_workers
 
         selected = sorted(
@@ -980,6 +1160,18 @@ class SpmdFedAvgSession:
         idx[: len(selected)] = selected
         weights = np.zeros(self.s_pad, np.float32)
         weights[: len(selected)] = self._dataset_sizes[selected]
+        # dropped ids are masked out of the S_pad row (weight 0 — they
+        # still occupy a gathered slot but contribute exact zeros, like
+        # padding); same draw as the dense path, so gather/dense parity
+        # holds under injection too
+        weights = apply_fault_plan(
+            self._fault_plan,
+            self._min_quorum,
+            round_number,
+            idx,
+            weights,
+            self.config.worker_number,
+        )
         return idx, weights
 
     def _prepare_round_inputs(self, round_number: int, round_rng):
@@ -1144,19 +1336,32 @@ class SpmdFedAvgSession:
                 # cost (what the aggregation consumed over ICI, priced at
                 # the reference's message sizes) + round wall time
                 selected = int((host_weights > 0).sum())
+                extra = {
+                    "received_mb": selected
+                    * param_mb
+                    * self._upload_cost_factor(),
+                    "sent_mb": selected * param_mb,
+                    "round_seconds": _time.monotonic() - start,
+                }
+                rejected = 0
+                if self._update_guard:
+                    # the guard's per-round reject count rides the train
+                    # metrics; fetched alongside the eval metric (the
+                    # round's one host sync point), guard-gated so the
+                    # default path's sync budget is untouched
+                    rejected = int(
+                        np.asarray(train_metrics["rejected_updates"])
+                    )
+                    extra["rejected_updates"] = rejected
                 self._record(
-                    round_number,
-                    metric,
-                    global_params,
-                    save_dir,
-                    extra={
-                        "received_mb": selected
-                        * param_mb
-                        * self._upload_cost_factor(),
-                        "sent_mb": selected * param_mb,
-                        "round_seconds": _time.monotonic() - start,
-                    },
+                    round_number, metric, global_params, save_dir, extra=extra
                 )
+                # post-guard quorum: participating counts NaN-poisoned
+                # weights too (NaN != 0), matching the in-program rule
+                self._post_guard_quorum(
+                    round_number, (host_weights != 0).sum(), rejected
+                )
+                self._maybe_kill(round_number)
         return {"performance": self._stat}
 
     def _run_horizon(self) -> dict:
@@ -1224,6 +1429,14 @@ class SpmdFedAvgSession:
                 # ONE host sync per horizon: the stacked eval metrics
                 per_round = stacked_round_metrics(outs[1])
                 confusion = np.asarray(outs[2]) if len(outs) > 2 else None
+                # guard reject counts ride the stacked [H] train metrics —
+                # part of the same per-horizon sync, fetched only when the
+                # guard is compiled in
+                rejected_rows = (
+                    np.asarray(outs[0]["rejected_updates"])
+                    if self._update_guard
+                    else None
+                )
                 self.host_sync_count += 1
                 chunk_seconds = _time.monotonic() - start
                 for i in range(h):
@@ -1232,16 +1445,20 @@ class SpmdFedAvgSession:
                     if confusion is not None:
                         metric.update(slow_metrics_from_confusion(confusion[i]))
                     selected = int((host_weights[i] > 0).sum())
-                    self._note_round(
-                        r,
-                        metric,
-                        save_dir,
-                        extra={
-                            "received_mb": selected * param_mb * cost_factor,
-                            "sent_mb": selected * param_mb,
-                            "round_seconds": chunk_seconds / h,
-                        },
-                    )
+                    extra = {
+                        "received_mb": selected * param_mb * cost_factor,
+                        "sent_mb": selected * param_mb,
+                        "round_seconds": chunk_seconds / h,
+                    }
+                    if rejected_rows is not None:
+                        extra["rejected_updates"] = int(rejected_rows[i])
+                    self._note_round(r, metric, save_dir, extra=extra)
+                    if rejected_rows is not None:
+                        self._post_guard_quorum(
+                            r,
+                            (host_weights[i] != 0).sum(),
+                            rejected_rows[i],
+                        )
                     self._max_acc = max(self._max_acc, metric["accuracy"])
                     # only boundary rounds have a checkpoint to promote —
                     # best_global_model.npz tracks the best CHECKPOINTED
@@ -1257,6 +1474,11 @@ class SpmdFedAvgSession:
                             os.path.join(save_dir, "best_global_model.npz")
                         )
                 self.rounds_run += h
+                # a kill scheduled anywhere in the chunk fires at the
+                # horizon boundary (records + the boundary checkpoint are
+                # durable; a mid-horizon kill round simply resumes from an
+                # earlier boundary and re-trains the tail)
+                self._maybe_kill(round_number, boundary)
                 round_number += h
         return {"performance": self._stat}
 
@@ -1457,6 +1679,26 @@ class SpmdSignSGDSession:
             if self._selection_gather
             else self.n_slots
         )
+        # fault tolerance: the availability mask rides the 0/1 vote-weight
+        # rows (see SpmdFedAvgSession); the update guard masks non-finite
+        # per-step votes (sign-SGD has no round delta to norm-check —
+        # votes are ±1 — so the guard here is finiteness + weight hygiene)
+        from ..util.faults import FaultPlan
+
+        self._fault_plan = FaultPlan.from_config(config)
+        self._min_quorum = int(
+            config.algorithm_kwargs.get("min_client_quorum", 0) or 0
+        )
+        self._update_guard = bool(
+            self._fault_plan is not None and self._fault_plan.update_guard
+        )
+        # per-round weight rows are needed whenever selection OR fault
+        # injection varies the cohort round to round; the historical
+        # static-weights program (and its unmasked metric sums) is kept
+        # bit-exact for the plain full-participation case
+        self._per_round_weights = self._selection_active or bool(
+            self._fault_plan is not None and self._fault_plan.injection_active
+        )
 
         self._data, self._dataset_sizes, self.n_batches = stack_client_data(
             config, dataset_collection, practitioners, self.n_slots
@@ -1486,7 +1728,8 @@ class SpmdSignSGDSession:
         # trajectories stay bit-identical; under selection, unselected
         # clients must not leak into the recorded train curves (the
         # gather path never trains them at all)
-        mask_metrics = self._selection_active
+        mask_metrics = self._per_round_weights
+        guard_active = self._update_guard
 
         def shard_body(params, data, weights, rngs):
             # data: [n_batches, slots_local, B, ...]; weights/rngs: [slots_local(, 2)]
@@ -1507,11 +1750,32 @@ class SpmdSignSGDSession:
                     return grads, metrics
 
                 grads, metrics = jax.vmap(grad_one)(batch, rngs)
+                vote_weights = weights
+                rejected = None
+                if guard_active:
+                    # update hygiene, sign-SGD flavor: a slot whose step
+                    # gradient is non-finite — or whose vote weight
+                    # arrived poisoned (corrupt injection) — is masked
+                    # out of THIS step's majority vote and counted;
+                    # sign(NaN) would otherwise poison the direction for
+                    # every client at once
+                    finite = jnp.ones(weights.shape, bool)
+                    for g in jax.tree.leaves(grads):
+                        finite = finite & jnp.all(
+                            jnp.isfinite(g).reshape(g.shape[0], -1), axis=1
+                        )
+                    ok = finite & jnp.isfinite(weights)
+                    participating = (weights != 0).astype(jnp.float32)
+                    vote_weights = jnp.where(ok, weights, jnp.float32(0.0))
+                    rejected = jax.lax.psum(
+                        jnp.sum(jnp.where(ok, 0.0, participating)),
+                        axis_name="clients",
+                    )
                 # majority vote: sign of the sum of signs, padding slots
                 # masked out (weights ∈ {0, 1})
                 total = jax.tree.map(
                     lambda g: jax.lax.psum(
-                        jnp.einsum("c,c...->...", weights, jnp.sign(g)),
+                        jnp.einsum("c,c...->...", vote_weights, jnp.sign(g)),
                         axis_name="clients",
                     ),
                     grads,
@@ -1528,13 +1792,15 @@ class SpmdSignSGDSession:
                 )
                 metrics = jax.tree.map(
                     lambda m: jax.lax.psum(
-                        jnp.sum(m * weights, axis=0)
+                        jnp.sum(m * vote_weights, axis=0)
                         if mask_metrics
                         else jnp.sum(m, axis=0),
                         axis_name="clients",
                     ),
                     metrics,
                 )
+                if rejected is not None:
+                    metrics = dict(metrics, rejected_updates=rejected)
                 return (params, velocity, step + 1), metrics
 
             def epoch_body(carry, _):
@@ -1600,7 +1866,7 @@ class SpmdSignSGDSession:
         run_program = self._run_program_fn
         gather_program = self._gather_program_fn
         use_gather = self._selection_gather
-        per_round_weights = self._selection_active
+        per_round_weights = self._per_round_weights
         with_confusion = bool(self.config.use_slow_performance_metrics)
 
         def horizon_program(params, rng_rows, weights, idx_rows, data, eval_batches):
@@ -1650,20 +1916,29 @@ class SpmdSignSGDSession:
         """[n_slots] 0/1 participation weights for the DENSE program: real
         workers, intersected with the round's selection when
         ``random_client_number`` is active."""
-        base = (self._dataset_sizes > 0).astype(np.float32)
-        if not self._selection_active:
-            return base
-        from ..utils.selection import select_workers
+        from ..util.faults import apply_fault_plan
 
-        selected = select_workers(
-            self.config.seed,
+        base = (self._dataset_sizes > 0).astype(np.float32)
+        if self._selection_active:
+            from ..utils.selection import select_workers
+
+            selected = select_workers(
+                self.config.seed,
+                round_number,
+                self.config.worker_number,
+                self.config.algorithm_kwargs.get("random_client_number"),
+            )
+            mask = np.zeros(self.n_slots, np.float32)
+            mask[sorted(selected)] = 1.0
+            base = base * mask
+        return apply_fault_plan(
+            self._fault_plan,
+            self._min_quorum,
             round_number,
+            None,
+            base,
             self.config.worker_number,
-            self.config.algorithm_kwargs.get("random_client_number"),
         )
-        mask = np.zeros(self.n_slots, np.float32)
-        mask[sorted(selected)] = 1.0
-        return base * mask
 
     def _select_indices(
         self, round_number: int
@@ -1686,6 +1961,16 @@ class SpmdSignSGDSession:
         weights[: len(selected)] = (
             self._dataset_sizes[selected] > 0
         ).astype(np.float32)
+        from ..util.faults import apply_fault_plan
+
+        weights = apply_fault_plan(
+            self._fault_plan,
+            self._min_quorum,
+            round_number,
+            idx,
+            weights,
+            self.config.worker_number,
+        )
         return idx, weights
 
     @property
@@ -1712,6 +1997,12 @@ class SpmdSignSGDSession:
         for key, value in metric.items():  # slow-metric extras
             if key not in ("accuracy", "loss", "count"):
                 row[f"test_{key}"] = value
+        if "rejected_updates" in epoch_metrics:
+            # vote-guard rejections (non-finite grads / poisoned weights),
+            # summed over the round's steps
+            row["rejected_updates"] = float(
+                np.asarray(epoch_metrics["rejected_updates"]).sum()
+            )
         self._stat[round_number] = row
         get_logger().info(
             "round: %d, sign_SGD (spmd) %d steps, test accuracy %.4f loss %.4f",
@@ -1763,7 +2054,7 @@ class SpmdSignSGDSession:
                 sel_idx = put_sharded(host_idx, self._client_sharding)
                 round_weights = put_sharded(host_w, self._client_sharding)
                 rngs = put_sharded(host_rngs[host_idx], self._client_sharding)
-            elif self._selection_active:
+            elif self._per_round_weights:
                 sel_idx = None
                 round_weights = put_sharded(
                     self._round_weights(round_number), self._client_sharding
@@ -1800,6 +2091,12 @@ class SpmdSignSGDSession:
                     os.path.join(save_dir, "best_global_model.npz"),
                     **{k: np.asarray(v) for k, v in params.items()},
                 )
+            # sign_SGD writes no round checkpoints, so a killed run
+            # restarts from round 1 under train_with_recovery (documented
+            # in docs/migrating.md); the kill still fires after the record
+            # lands so the chaos suite can observe completed rounds
+            if self._fault_plan is not None:
+                self._fault_plan.maybe_kill(round_number)
         return {"performance": self._stat}
 
     def _run_horizon(self) -> dict:
@@ -1847,7 +2144,7 @@ class SpmdSignSGDSession:
                 weight_arg = put_sharded(
                     np.stack([w for _i, w in pairs]), rng_sharding
                 )
-            elif self._selection_active:
+            elif self._per_round_weights:
                 weight_arg = put_sharded(
                     np.stack([self._round_weights(r) for r in rounds]),
                     rng_sharding,
@@ -1879,6 +2176,9 @@ class SpmdSignSGDSession:
                     os.path.join(save_dir, "best_global_model.npz"),
                     **{k: np.asarray(v) for k, v in params.items()},
                 )
+            if self._fault_plan is not None:
+                for r in range(round_number, boundary + 1):
+                    self._fault_plan.maybe_kill(r)
             round_number += h
         return {"performance": self._stat}
 

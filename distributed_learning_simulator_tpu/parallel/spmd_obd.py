@@ -44,7 +44,13 @@ from ..method.fed_obd.obd_algorithm import get_module_blocks
 from ..ops.quantization import nnadq_quantize_dequantize
 from ..utils.logging import get_logger
 from .mesh import put_sharded
-from .spmd import SpmdFedAvgSession, scan_local_epochs_carry, shard_map_compat
+from .spmd import (
+    SpmdFedAvgSession,
+    guard_client_update,
+    guarded_average,
+    scan_local_epochs_carry,
+    shard_map_compat,
+)
 from jax.sharding import PartitionSpec as P
 
 
@@ -137,6 +143,17 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         # programs and reject the knob loudly (base __init__ raises)
         return type(self) is SpmdFedOBDSession
 
+    def _update_guard_unsupported_reason(self) -> str | None:
+        # the client-axis phase programs compile the guard in (per-client
+        # upload hygiene + survivor-renormalized total); the ep/sp
+        # subclasses keep their own whole-mesh-per-client programs
+        if type(self) is not SpmdFedOBDSession:
+            return (
+                f"{type(self).__name__} lays clients out as a"
+                " whole-mesh-per-client scan (own phase programs)"
+            )
+        return None
+
     def _select_indices(self, round_number: int):
         """Gather-path selection, OBD flavor: ascending selected worker
         ids padded to ``s_pad`` with DISTINCT unselected slot ids at
@@ -161,6 +178,19 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         )
         weights = np.zeros(self.s_pad, np.float32)
         weights[: len(selected)] = self._dataset_sizes[selected]
+        from ..util.faults import apply_fault_plan
+
+        # dropped ids masked out of the S_pad row at weight 0 — the
+        # masked-merge then keeps their opt states untouched, exactly like
+        # an unselected round (a dropout IS a missed participation)
+        weights = apply_fault_plan(
+            self._fault_plan,
+            self._min_quorum,
+            round_number,
+            idx,
+            weights,
+            self.config.worker_number,
+        )
         return idx, weights
 
     # ------------------------------------------------------------------
@@ -202,6 +232,8 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         block_sizes = jnp.asarray(self._block_sizes)
         block_id = self._block_id
         threshold = (1.0 - self._dropout_rate) * self._total_params
+        guard_active = self._update_guard
+        max_update_norm = self._max_update_norm
 
         if self._codec == "qsgd":
             from ..ops.quantization import qsgd_quantize_dequantize
@@ -278,6 +310,16 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                     # complete(): dropped blocks fall back to the old global
                     upload[k] = jnp.where(mask, g + dq, g)
                     upload_bits += mask * bits * v.size
+            if guard_active:
+                # update hygiene on the codec'd upload (what aggregation
+                # would actually consume) — the guard shared with the
+                # FedAvg round program (spmd.py::guard_client_update).
+                # The slot's opt-state continuation keeps its trained
+                # state: rejection excludes the upload, it does not roll
+                # back the client's local trajectory.
+                weight, summed = guard_client_update(
+                    upload, global_params, weight, summed, max_update_norm
+                )
             contribution = jax.tree.map(lambda p: p * weight, upload)
             summed = dict(summed, upload_bits=upload_bits * selected)
             return contribution, opt_out, summed
@@ -398,14 +440,29 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 global_sum = jax.tree.map(
                     lambda s: jax.lax.psum(s, axis_name="clients"), local_sum
                 )
-                total_weight = jax.lax.psum(jnp.sum(weights), axis_name="clients")
-                new_global = jax.tree.map(
-                    lambda s, g: (s / jnp.maximum(total_weight, 1e-12)).astype(
-                        g.dtype
-                    ),
-                    global_sum,
-                    global_params,
-                )
+                if self._update_guard:
+                    # survivor renormalization: sum of the guard's
+                    # effective per-slot weights (rejected slots at zero);
+                    # a zero-survivor round keeps the old global instead
+                    # of zeroing the model
+                    metrics = dict(metrics)
+                    total_weight = jax.lax.psum(
+                        metrics.pop("_eff_weight"), axis_name="clients"
+                    )
+                    new_global = guarded_average(
+                        global_sum, total_weight, global_params
+                    )
+                else:
+                    total_weight = jax.lax.psum(
+                        jnp.sum(weights), axis_name="clients"
+                    )
+                    new_global = jax.tree.map(
+                        lambda s, g: (
+                            s / jnp.maximum(total_weight, 1e-12)
+                        ).astype(g.dtype),
+                        global_sum,
+                        global_params,
+                    )
                 metrics = jax.tree.map(
                     lambda m: jax.lax.psum(jnp.sum(m), axis_name="clients"),
                     metrics,
@@ -714,6 +771,21 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         weights[self.config.worker_number :] = 0.0
         return weights
 
+    def _phase2_weights(self, stat_key: int) -> np.ndarray:
+        """Phase-2 (nominally full-participation) weights with the round's
+        availability mask folded in — phase-2 epochs drop/corrupt clients
+        exactly like phase-1 rounds, keyed by the aggregate's stat key."""
+        from ..util.faults import apply_fault_plan
+
+        return apply_fault_plan(
+            self._fault_plan,
+            self._min_quorum,
+            stat_key,
+            None,
+            self._all_weights(),
+            self.config.worker_number,
+        )
+
     def run(self) -> dict:
         """Drive the phases off the SAME :class:`ObdRoundDriver` the
         threaded server uses (``method/fed_obd/driver.py``) — the round
@@ -867,13 +939,14 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                     sel_host = None
                     if phase_two:
                         fn = self._phase2_fn
-                        weights = self._all_weights()
+                        weights = self._phase2_weights(key)
                     else:
                         fn = self._phase1_fn
                         if self._selection_gather:
                             sel_host, weights = self._select_indices(key)
                         else:
                             weights = self._select_weights(key)
+                    participating = int((weights != 0).sum())
                     exact, train_params, met = step(
                         fn, train_params, weights, key, phase_label,
                         use_opt=carry_opt, sel_host=sel_host,
@@ -889,6 +962,9 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                     self._record_obd(
                         key, metric, met, exact, save_dir, spec.name
                     )
+                    self._post_guard_quorum(
+                        key, participating, met.get("rejected_updates", 0)
+                    )
                     improved = True
                     if driver.early_stop:
                         improved = self._has_improvement()
@@ -903,12 +979,14 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                         )
                     if phase_two:
                         idx_rows = None
+                        host_rows = np.stack(
+                            [self._phase2_weights(k) for k in keys]
+                        )
                         weight_rows = put_sharded(
-                            np.tile(self._all_weights(), (h, 1)),
-                            self._horizon_weight_sharding,
+                            host_rows, self._horizon_weight_sharding
                         )
                     else:
-                        _hw, weight_rows, idx_rows = (
+                        host_rows, weight_rows, idx_rows = (
                             self._horizon_selection_rows(keys[0], h)
                         )
                     # params, the opt carry AND the rng chain are donated
@@ -946,6 +1024,11 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                             exact if key == keys[-1] else None,
                             save_dir, spec.name,
                         )
+                        self._post_guard_quorum(
+                            key,
+                            (host_rows[i] != 0).sum(),
+                            met.get("rejected_updates", 0),
+                        )
                         # h never exceeds the phase budget, so only the
                         # final tick can switch phases / end training
                         decision = driver.after_aggregate(
@@ -962,6 +1045,11 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                         "phase switch -> %s",
                         driver.phase and driver.phase.name,
                     )
+                # kills fire only after the chunk's records, the boundary
+                # checkpoint, and the opt-state save are all queued — the
+                # writer drains on the raise (``with self._ckpt``), so the
+                # resume replay finds a consistent phase state
+                self._maybe_kill(keys[0], keys[-1])
                 if decision.end_training:
                     break
         return {"performance": self._stat}
@@ -978,6 +1066,8 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             # the driver's transitions from the record alone
             "phase": phase_name,
         }
+        if "rejected_updates" in round_metrics:
+            extra["rejected_updates"] = round_metrics["rejected_updates"]
         if exact is None:
             # mid-horizon round under fusion: the exact aggregate was
             # never materialized — stat row only; checkpoints land on

@@ -37,6 +37,20 @@ class AggregationServer(Server):
         self.__max_acc = 0.0  # plateau bookkeeping (owned by _convergent)
         self.need_init_performance = False
         self.__early_stop = self.config.algorithm_kwargs.get("early_stop", False)
+        # fault tolerance (util/faults.py): quorum floor, per-round fault
+        # stat columns, and the scheduled process kills all key off the
+        # same plan the SPMD sessions consume
+        from ..util.faults import FaultPlan
+
+        self._fault_plan = FaultPlan.from_config(self.config)
+        self._min_quorum = int(
+            self.config.algorithm_kwargs.get("min_client_quorum", 0) or 0
+        )
+        # kill deferral bookkeeping: a scheduled kill fires only once the
+        # killed round has a SAVED checkpoint, so a resumed run starts
+        # past it and the stateless plan never re-fires the same kill
+        self._kill_armed_round: int | None = None
+        self._last_saved_key = 0
         import time as _time
 
         self.__round_start = _time.monotonic()
@@ -84,6 +98,7 @@ class AggregationServer(Server):
             self.__best_acc = restored_max
             self.__max_acc = restored_max
         self._round_number = last_round + 1
+        self._last_saved_key = last_round  # kill deferral: already durable
         get_logger().info("resumed from %s at round %d", resume_dir, self._round_number)
         return resumed_params
 
@@ -123,7 +138,42 @@ class AggregationServer(Server):
             self._send_result(result)
             self._worker_flag.clear()
 
+    def pending_workers(self) -> set[int]:
+        """Workers the current round is still waiting on — the stall
+        watchdog demotes these to permanent dropouts instead of aborting
+        the task when ``fault_tolerance.client_faults_nonfatal`` is set."""
+        return set(range(self.worker_number)) - set(self._worker_flag)
+
+    def _quorum_floor(self) -> int:
+        """``algorithm_kwargs.min_client_quorum``, with a floor of 1 under
+        any active fault machinery (injection, nonfatal client faults, OR
+        the update guard — a guard-only plan can still reject every
+        upload) — an all-dropped/all-rejected round must abort loudly,
+        never "aggregate" an empty upload set."""
+        plan = self._fault_plan
+        active = plan is not None and (
+            plan.injection_active
+            or plan.client_faults_nonfatal
+            or plan.update_guard
+        )
+        return max(self._min_quorum, 1 if active else 0)
+
     def _aggregate_worker_data(self) -> Message:
+        quorum = self._quorum_floor()
+        if quorum:
+            survivors = len(self.__algorithm.all_worker_data)
+            if survivors < quorum:
+                from ..util.faults import QuorumLostError
+
+                message = (
+                    f"round {self._round_number}: {survivors} surviving "
+                    f"uploads below min_client_quorum={quorum} "
+                    f"(skipped: {sorted(self.__algorithm.skipped_workers)}, "
+                    f"rejected: {sorted(self.__algorithm.rejected_workers)})"
+                    " — aborting the round loudly"
+                )
+                get_logger().error(message)
+                raise QuorumLostError(message)
         return self.__algorithm.aggregate_worker_data()
 
     def _before_send_result(self, result: Message) -> None:
@@ -166,10 +216,27 @@ class AggregationServer(Server):
                 or result.end_training
             ):
                 self._model_cache.save()
+                self._last_saved_key = recorded_key
 
     def _after_send_result(self, result: Message) -> None:
         if isinstance(result, ParameterMessageBase) and not result.in_round:
             self._round_number += 1
+            # FaultPlan process kills arm at their scheduled round but
+            # fire only once a checkpoint ≥ that round is SAVED (record
+            # rows are written synchronously every round) — a sparse
+            # checkpoint_every cadence defers the kill to the next saved
+            # round, so resume always starts past it and the stateless
+            # plan never re-fires the same kill
+            if self._fault_plan is not None:
+                completed = self._round_number - 1
+                self._kill_armed_round = self._fault_plan.arm_kill(
+                    completed, completed, self._kill_armed_round
+                )
+                # record rows are written synchronously every round here,
+                # so durability reduces to the last SAVED checkpoint key
+                self._fault_plan.fire_armed_kill(
+                    self._kill_armed_round, self._last_saved_key
+                )
         self.__algorithm.clear_worker_data()
 
     def _stopped(self) -> bool:
@@ -211,6 +278,26 @@ class AggregationServer(Server):
         round_stat["sent_mb"] = (self.sent_bytes - self.__round_start_bytes[1]) / 1e6
         self.__round_start = now
         self.__round_start_bytes = (self.received_bytes, self.sent_bytes)
+        plan = self._fault_plan
+        if plan is not None and (
+            plan.injection_active
+            or plan.client_faults_nonfatal
+            or plan.update_guard
+        ):
+            # fault observability: how many uploads the guard rejected and
+            # how many selected clients dropped (injected, crashed, or
+            # watchdog-demoted) this round
+            algo = self.__algorithm
+            round_stat["rejected_updates"] = len(algo.rejected_workers)
+            dead = set(
+                getattr(self._task_context, "dropped_workers", None) or ()
+            )
+            injected = plan.dropped_clients(
+                self._round_number, self.worker_number
+            )
+            round_stat["dropped_clients"] = len(
+                algo.skipped_workers & (dead | set(injected))
+            )
         self._annotate_stat(round_stat)
         key = self._get_stat_key()
         assert key not in self.__stat

@@ -93,6 +93,20 @@ class Server(Executor):
                 if not worker_set:
                     worker_set = self._active_workers()
                 progressed = False
+                # fault tolerance (util/faults.py): a worker whose thread
+                # died (or was demoted by the stall watchdog) under
+                # ``fault_tolerance.client_faults_nonfatal`` can never
+                # upload again — synthesize its per-round ``None`` (the
+                # existing skipped-worker path) so every round completes
+                # over the survivors instead of waiting forever.  A last
+                # upload still queued from before the death is consumed
+                # first.
+                for worker_id in sorted(self._dropped_workers() & worker_set):
+                    if self._endpoint.has_data(worker_id):
+                        continue
+                    self._process_worker_data(worker_id, None)
+                    worker_set.remove(worker_id)
+                    progressed = True
                 for worker_id in sorted(worker_set):
                     if self._endpoint.has_data(worker_id):
                         data = self._endpoint.get(worker_id)
@@ -141,6 +155,13 @@ class Server(Executor):
         """Workers the event loop still expects messages from (subclasses
         shrink this as workers finish — per-step gradient methods)."""
         return set(range(self._endpoint.worker_num))
+
+    def _dropped_workers(self) -> set[int]:
+        """Workers permanently demoted to dropouts (crashed threads /
+        watchdog-demoted stragglers) under
+        ``fault_tolerance.client_faults_nonfatal``."""
+        ctx = self._task_context
+        return set(getattr(ctx, "dropped_workers", None) or ())
 
     def _select_workers(self) -> set[int]:
         """Random client selection (reference ``server.py:123-131``),

@@ -45,6 +45,10 @@ class TaskContext:
     abort_event: threading.Event = dataclasses.field(default_factory=threading.Event)
     threads: list = dataclasses.field(default_factory=list)
     errors: list = dataclasses.field(default_factory=list)
+    # workers permanently demoted to dropouts (crashed threads, watchdog-
+    # demoted stragglers) under fault_tolerance.client_faults_nonfatal —
+    # the server's event loop synthesizes their per-round Nones
+    dropped_workers: set = dataclasses.field(default_factory=set)
     server: Any = None
     workers: list = dataclasses.field(default_factory=list)
     practitioners: list = dataclasses.field(default_factory=list)
@@ -233,12 +237,34 @@ def _spawn(ctx: TaskContext) -> None:
         )
         ctx.workers.append(worker)
 
+    nonfatal_clients = bool(
+        dict(config.fault_tolerance or {}).get("client_faults_nonfatal")
+    )
+
     def run(executor) -> None:
         try:
             executor.start()
         except TaskAbortedError:
             get_logger().debug("%s aborted", executor.name)
         except Exception as exc:  # noqa: BLE001 — propagate to the caller
+            worker_id = getattr(executor, "worker_id", None)
+            if nonfatal_clients and worker_id is not None:
+                # fault_tolerance.client_faults_nonfatal: a crashed WORKER
+                # becomes a permanent dropout, not a whole-task abort —
+                # the server synthesizes its per-round None and every
+                # remaining round completes over the survivors (server
+                # faults stay fatal: there is nobody to aggregate without
+                # it)
+                get_logger().warning(
+                    "%s failed (%s: %s) — demoted to a dropout "
+                    "(fault_tolerance.client_faults_nonfatal)",
+                    executor.name,
+                    type(exc).__name__,
+                    exc,
+                )
+                ctx.dropped_workers.add(worker_id)
+                ctx.topology.server_wakeup.set()
+                return
             get_logger().exception("%s failed", executor.name)
             ctx.errors.append(exc)
             ctx.abort_event.set()
@@ -280,6 +306,31 @@ def _watchdog_loop(ctx: TaskContext, stall_seconds: float, poll: float = 0.0) ->
             continue
         stalled = _time.monotonic() - stall_start
         if stalled > stall_seconds:
+            nonfatal = bool(
+                dict(
+                    getattr(ctx.config, "fault_tolerance", None) or {}
+                ).get("client_faults_nonfatal")
+            )
+            pending_fn = getattr(ctx.server, "pending_workers", None)
+            if nonfatal and pending_fn is not None:
+                # a worker timeout becomes a dropout, not an abort: demote
+                # the workers the server's round is still waiting on, wake
+                # the event loop (it synthesizes their Nones), and keep
+                # watching.  Only when the server itself is wedged — no
+                # pending worker left to blame — does the stall abort.
+                pending = set(pending_fn()) - set(ctx.dropped_workers)
+                if pending:
+                    get_logger().warning(
+                        "watchdog: no message progress for %.0fs; demoting "
+                        "stalled workers %s to dropouts "
+                        "(fault_tolerance.client_faults_nonfatal)",
+                        stalled,
+                        sorted(pending),
+                    )
+                    ctx.dropped_workers.update(pending)
+                    ctx.topology.server_wakeup.set()
+                    stall_start = _time.monotonic()
+                    continue
             waiting = [t.name for t in ctx.threads if t.is_alive()]
             get_logger().error(
                 "watchdog: no message progress for %.0fs (threshold %.0fs); "
@@ -358,6 +409,136 @@ def train(
         profiler_cm = jax.profiler.trace(trace_dir)
     with profiler_cm:
         return _run_task(ctx, return_task_id=return_task_id, task_id=task_id)
+
+
+def train_with_recovery(
+    config: DistributedTrainingConfig,
+    practitioners=None,
+    max_restarts: int | None = None,
+    backoff_seconds: float | None = None,
+    sleep_fn=None,
+    **kwargs: Any,
+) -> dict:
+    """Self-healing :func:`train`: a bounded-retry supervisor that catches
+    a crashed run (preemption, injected FaultPlan kill, infra fault — NOT
+    Ctrl-C), backs off exponentially, and relaunches from the newest
+    **loadable** checkpoint automatically instead of waiting for an
+    operator (the active half of the SURVEY §5 recovery story; the passive
+    half is ``algorithm_kwargs.resume_dir`` + ``util/resume.py``).
+
+    Supervisor contract:
+
+    * attempt ``k`` runs in ``<save_dir>_retry<k>`` and resumes from the
+      newest attempt directory with a loadable ``round_N.npz`` + record
+      row pair (``util/resume.resumable_round`` validates loadability —
+      a torn newest checkpoint falls back to the previous round);
+    * retries and backoff default from ``config.fault_tolerance``
+      (``max_restarts``, ``restart_backoff_seconds``); after
+      ``max_restarts`` relaunches the last error propagates unchanged;
+    * the returned result is the final attempt's — its restored + fresh
+      record rows cover every completed round exactly once — plus a
+      ``recovery`` summary (restart count, attempt dirs, final save_dir);
+    * methods without round checkpoints (sign_SGD) restart from round 1
+      each attempt: the supervisor still bounds the retries.
+
+    ``sleep_fn`` is a test seam for the backoff.
+    """
+    import time as _time
+
+    config = copy.deepcopy(config)
+    if not config.save_dir:
+        config.load_config_and_process()
+    fault_conf = dict(config.fault_tolerance or {})
+    if max_restarts is None:
+        max_restarts = int(fault_conf.get("max_restarts", 2))
+    if backoff_seconds is None:
+        backoff_seconds = float(fault_conf.get("restart_backoff_seconds", 1.0))
+    sleep = sleep_fn if sleep_fn is not None else _time.sleep
+    assert not kwargs.get("return_task_id"), (
+        "train_with_recovery supervises a foreground run; background task "
+        "mode has no crash to catch on this thread"
+    )
+    base_dir = config.save_dir
+    attempt_dirs = [base_dir]
+    current = config
+    restarts = 0
+    while True:
+        try:
+            result = train(current, practitioners=practitioners, **kwargs)
+            result["recovery"] = {
+                "restarts": restarts,
+                "attempt_dirs": list(attempt_dirs),
+                "save_dir": current.save_dir,
+            }
+            return result
+        except (KeyboardInterrupt, SystemExit):
+            raise  # an operator stop is not a fault to heal
+        except Exception as exc:  # noqa: BLE001 — supervise any crash
+            restarts += 1
+            if restarts > max_restarts:
+                get_logger().error(
+                    "train_with_recovery: giving up after %d restart(s); "
+                    "last error: %s",
+                    max_restarts,
+                    exc,
+                )
+                raise
+            delay = backoff_seconds * (2 ** (restarts - 1))
+            get_logger().warning(
+                "train_with_recovery: attempt %d crashed (%s: %s); "
+                "relaunching in %.1fs (%d/%d restarts)",
+                restarts,
+                type(exc).__name__,
+                exc,
+                delay,
+                restarts,
+                max_restarts,
+            )
+            if delay > 0:
+                sleep(delay)
+            from .util.resume import resumable_round
+
+            # newest attempt with a LOADABLE checkpoint+record pair wins;
+            # a run that crashed before its first checkpoint falls back to
+            # the attempt before it (or a caller-provided resume_dir).
+            # resumable_round fully loads the candidate checkpoint to
+            # validate it, so compute it once per candidate and stop at
+            # the first hit — no re-validation for the log line.
+            candidates = list(reversed(attempt_dirs))
+            caller_resume = dict(config.algorithm_kwargs or {}).get(
+                "resume_dir"
+            )
+            if caller_resume:
+                candidates.append(caller_resume)
+            resume_dir, resume_round = None, 0
+            for candidate in candidates:
+                if not candidate:
+                    continue
+                resume_round = resumable_round(candidate)
+                if resume_round > 0:
+                    resume_dir = candidate
+                    break
+            current = current.replace(
+                save_dir=f"{base_dir}_retry{restarts}"
+            )
+            current.algorithm_kwargs = dict(current.algorithm_kwargs)
+            if resume_dir is not None:
+                get_logger().info(
+                    "train_with_recovery: resuming attempt %d from %s "
+                    "(round %d)",
+                    restarts + 1,
+                    resume_dir,
+                    resume_round,
+                )
+                current.algorithm_kwargs["resume_dir"] = resume_dir
+            else:
+                get_logger().warning(
+                    "train_with_recovery: nothing resumable yet — attempt "
+                    "%d restarts from scratch",
+                    restarts + 1,
+                )
+                current.algorithm_kwargs.pop("resume_dir", None)
+            attempt_dirs.append(current.save_dir)
 
 
 def _session_fed_avg(ctx, args, kwargs):

@@ -25,6 +25,13 @@ import queue
 import threading
 
 
+class CheckpointError(RuntimeError):
+    """Misuse of the checkpoint writer (e.g. promoting before any save).
+    A real exception, not an ``assert`` — ``python -O`` strips asserts,
+    and the recovery supervisor must be able to catch and classify this
+    instead of dying on an AssertionError with no message."""
+
+
 def atomic_json_dump(path: str, obj) -> None:
     """Write ``obj`` as JSON via a tmp file + rename: a reader (or a crash
     mid-write) never sees a torn file — the contract round_record.json
@@ -124,7 +131,11 @@ class AsyncCheckpointWriter:
         without a second device fetch.  Runs after the save it refers to
         (same FIFO), without blocking the caller."""
         source = self._last_path
-        assert source is not None, "no checkpoint saved yet"
+        if source is None:
+            raise CheckpointError(
+                "copy_last_to called before any save_npz — there is no "
+                "checkpoint to promote"
+            )
         save_ok = self._last_save_ok
         import shutil
 

@@ -1,0 +1,401 @@
+"""Deterministic fault injection + the fault-tolerance vocabulary.
+
+The reference simulator has NO failure model (SURVEY.md §5): every selected
+client is assumed to upload, and a killed run restarts from round 1.  Real
+federated deployments are defined by the opposite (Bonawitz et al., *Towards
+Federated Learning at Scale*): clients drop mid-round, straggle, or return
+garbage, and the server is built around completing rounds with fewer
+clients than it selected.  This module is the testable half of that story —
+a :class:`FaultPlan` is a **seeded, deterministic schedule** of client
+dropouts, straggler delays, corrupt-update injections, and process kills,
+driven entirely from ``config.fault_tolerance``:
+
+.. code-block:: yaml
+
+    fault_tolerance:
+      seed: 0                      # fault stream seed (NOT the training seed)
+      dropout_rate: 0.1            # per-(round, client) Bernoulli dropout
+      dropout_schedule: {2: [0, 3]}  # explicit per-round dropped worker ids
+      straggler_rate: 0.0          # per-(round, client) straggle draw ...
+      straggler_delay_seconds: 0.0 # ... each sleeping this long (host-side)
+      straggler_schedule: {}
+      corrupt_rate: 0.0            # per-(round, client) poisoned upload
+      corrupt_schedule: {}
+      kill_after_rounds: [3]       # SimulatedPreemption AFTER recording round 3
+      update_guard: false          # device-side non-finite/norm reject
+      max_update_norm: 0.0         # 0 = finiteness check only
+      client_faults_nonfatal: false  # threaded: worker fault -> dropout
+      max_restarts: 2              # train_with_recovery retry budget
+      restart_backoff_seconds: 1.0
+
+Every draw is keyed by ``(fault seed, round, stream)`` — two runs of the
+same config see the identical fault sequence, which is what makes the
+chaos suite (``tests/test_fault_recovery.py``, the ``test.sh`` fault smoke)
+pin exact outcomes.  Kills fire *after* round N's checkpoint+record land,
+so a resumed run starts at N+1 and never re-trips the same kill — the
+:func:`~distributed_learning_simulator_tpu.training.train_with_recovery`
+supervisor needs no cross-attempt kill bookkeeping.
+
+How each fault class maps onto the executors:
+
+* **dropout** — SPMD: the client's aggregation weight is zeroed in the
+  host-built weight row (the availability mask folded into the same
+  ``[S_pad]`` / ``[H, S_pad]`` weight matrices selection already rides, so
+  the jitted round programs are untouched: a dropped client contributes
+  exact zeros and ``total_weight`` renormalizes over survivors).  Threaded:
+  the worker uploads ``None`` for the round (the server's existing
+  skipped-worker path).
+* **corruption** — SPMD: the client's weight becomes NaN (garbage at the
+  aggregation boundary; the in-program update guard rejects it exactly like
+  a non-finite training delta — without the guard it visibly poisons the
+  aggregate).  Threaded: the uploaded tensors themselves are NaN-poisoned.
+* **stragglers** — a host-side sleep (the SPMD round completes when the
+  slowest upload would have arrived; the threaded worker sleeps before
+  sending).
+* **kills** — :class:`SimulatedPreemption` raised from the run loop after
+  the round's artifacts are durable.
+"""
+
+import dataclasses
+import random
+import time
+from typing import Any, Mapping
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+
+class ClientFaultError(RuntimeError):
+    """An injected (or real) client-side fault on the threaded executor."""
+
+
+class QuorumLostError(RuntimeError):
+    """A round's surviving uploads fell below ``min_client_quorum``."""
+
+
+class SimulatedPreemption(RuntimeError):
+    """A FaultPlan-scheduled process kill (fires AFTER the round's
+    checkpoint and record row are durable, so resume lands cleanly)."""
+
+
+_KNOWN_KEYS = frozenset(
+    {
+        "seed",
+        "dropout_rate",
+        "dropout_schedule",
+        "straggler_rate",
+        "straggler_delay_seconds",
+        "straggler_schedule",
+        "corrupt_rate",
+        "corrupt_schedule",
+        "kill_after_rounds",
+        "update_guard",
+        "max_update_norm",
+        "client_faults_nonfatal",
+        "auto_resume",
+        "max_restarts",
+        "restart_backoff_seconds",
+    }
+)
+
+# stream ids keep the per-round Bernoulli draws independent per fault class
+_DROPOUT_STREAM = 1
+_STRAGGLER_STREAM = 2
+_CORRUPT_STREAM = 3
+
+
+def _normalize_schedule(raw: Any) -> dict[int, frozenset[int]]:
+    """YAML/override schedules arrive with string keys and list values —
+    normalize to ``{round: frozenset(worker_ids)}``."""
+    if not raw:
+        return {}
+    out: dict[int, frozenset[int]] = {}
+    for key, ids in dict(raw).items():
+        if isinstance(ids, int):
+            ids = [ids]
+        out[int(key)] = frozenset(int(i) for i in ids)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    seed: int = 0
+    dropout_rate: float = 0.0
+    dropout_schedule: Mapping[int, frozenset[int]] = dataclasses.field(
+        default_factory=dict
+    )
+    straggler_rate: float = 0.0
+    straggler_delay_seconds: float = 0.0
+    straggler_schedule: Mapping[int, frozenset[int]] = dataclasses.field(
+        default_factory=dict
+    )
+    corrupt_rate: float = 0.0
+    corrupt_schedule: Mapping[int, frozenset[int]] = dataclasses.field(
+        default_factory=dict
+    )
+    kill_after_rounds: tuple[int, ...] = ()
+    update_guard: bool = False
+    max_update_norm: float = 0.0
+    client_faults_nonfatal: bool = False
+    #: CLI surface: ``simulator.py`` runs under the train_with_recovery
+    #: supervisor instead of a bare train() when set
+    auto_resume: bool = False
+    max_restarts: int = 2
+    restart_backoff_seconds: float = 1.0
+
+    @classmethod
+    def from_config(cls, config) -> "FaultPlan | None":
+        """Build the plan from ``config.fault_tolerance`` (None when the
+        dict is absent/empty — the zero-overhead default).  Unknown keys
+        raise: an accepted-but-never-read fault knob is a silent config
+        drop (the repo's config-honesty rule, test_conf_keys_consumed)."""
+        raw = dict(getattr(config, "fault_tolerance", None) or {})
+        if not raw:
+            return None
+        unknown = set(raw) - _KNOWN_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown fault_tolerance keys {sorted(unknown)}; "
+                f"known: {sorted(_KNOWN_KEYS)}"
+            )
+        kills = raw.get("kill_after_rounds") or ()
+        if isinstance(kills, int):
+            kills = (kills,)
+        max_norm = float(raw.get("max_update_norm", 0.0) or 0.0)
+        return cls(
+            seed=int(raw.get("seed", 0) or 0),
+            dropout_rate=float(raw.get("dropout_rate", 0.0) or 0.0),
+            dropout_schedule=_normalize_schedule(raw.get("dropout_schedule")),
+            straggler_rate=float(raw.get("straggler_rate", 0.0) or 0.0),
+            straggler_delay_seconds=float(
+                raw.get("straggler_delay_seconds", 0.0) or 0.0
+            ),
+            straggler_schedule=_normalize_schedule(
+                raw.get("straggler_schedule")
+            ),
+            corrupt_rate=float(raw.get("corrupt_rate", 0.0) or 0.0),
+            corrupt_schedule=_normalize_schedule(raw.get("corrupt_schedule")),
+            kill_after_rounds=tuple(int(r) for r in kills),
+            update_guard=bool(raw.get("update_guard", False))
+            or max_norm > 0,
+            max_update_norm=max_norm,
+            client_faults_nonfatal=bool(
+                raw.get("client_faults_nonfatal", False)
+            ),
+            auto_resume=bool(raw.get("auto_resume", False)),
+            max_restarts=int(raw.get("max_restarts", 2)),
+            restart_backoff_seconds=float(
+                raw.get("restart_backoff_seconds", 1.0)
+            ),
+        )
+
+    # ------------------------------------------------------------------
+    @property
+    def injection_active(self) -> bool:
+        """Whether this plan ever injects anything (a guard/supervisor-only
+        plan leaves every round untouched — and bit-exact)."""
+        return bool(
+            self.dropout_rate
+            or self.dropout_schedule
+            or self.straggler_rate
+            or self.straggler_schedule
+            or self.corrupt_rate
+            or self.corrupt_schedule
+            or self.kill_after_rounds
+        )
+
+    def _draw(
+        self,
+        stream: int,
+        round_number: int,
+        worker_number: int,
+        rate: float,
+        schedule: Mapping[int, frozenset[int]],
+    ) -> frozenset[int]:
+        scheduled = schedule.get(round_number, frozenset())
+        if rate <= 0.0:
+            return scheduled
+        rng = random.Random(
+            (self.seed * 1_000_003 + round_number) * 31 + stream
+        )
+        drawn = frozenset(
+            w for w in range(worker_number) if rng.random() < rate
+        )
+        return scheduled | drawn
+
+    def dropped_clients(
+        self, round_number: int, worker_number: int
+    ) -> frozenset[int]:
+        return self._draw(
+            _DROPOUT_STREAM,
+            round_number,
+            worker_number,
+            self.dropout_rate,
+            self.dropout_schedule,
+        )
+
+    def straggling_clients(
+        self, round_number: int, worker_number: int
+    ) -> frozenset[int]:
+        return self._draw(
+            _STRAGGLER_STREAM,
+            round_number,
+            worker_number,
+            self.straggler_rate,
+            self.straggler_schedule,
+        )
+
+    def corrupt_clients(
+        self, round_number: int, worker_number: int
+    ) -> frozenset[int]:
+        return self._draw(
+            _CORRUPT_STREAM,
+            round_number,
+            worker_number,
+            self.corrupt_rate,
+            self.corrupt_schedule,
+        )
+
+    # ------------------------------------------------------------------
+    def straggler_sleep(
+        self, round_number: int, worker_number: int, worker_id: int | None = None
+    ) -> None:
+        """Host-side straggler delay.  With ``worker_id`` (threaded path):
+        sleep iff that worker straggles this round.  Without (SPMD path):
+        sleep once iff ANY client straggles — the lock-step round completes
+        when the slowest upload arrives, so one max-delay models it."""
+        if self.straggler_delay_seconds <= 0:
+            return
+        straggling = self.straggling_clients(round_number, worker_number)
+        if not straggling:
+            return
+        if worker_id is not None and worker_id not in straggling:
+            return
+        time.sleep(self.straggler_delay_seconds)
+
+    def should_kill_after(self, round_number: int) -> bool:
+        return round_number in self.kill_after_rounds
+
+    def maybe_kill(self, round_number: int) -> None:
+        """Raise :class:`SimulatedPreemption` when the plan schedules a
+        kill after ``round_number`` — the immediate, deferral-free variant
+        for sessions with no round checkpoints (sign_SGD), where there is
+        no durable boundary to wait for."""
+        if self.should_kill_after(round_number):
+            raise SimulatedPreemption(
+                f"fault plan: simulated process kill after round {round_number}"
+            )
+
+    # -- deferred kills: THE arm/fire state machine both executors use --
+    # The plan is stateless across restarts on the premise that a resumed
+    # run starts PAST the killed round; that only holds if the kill fires
+    # once a durable artifact ≥ its round exists, so sparse checkpoint
+    # cadences simply defer the kill to the next durable boundary.  The
+    # armed round lives on the caller (it is per-run state); the rule for
+    # arming and firing lives here so the executors cannot drift.
+
+    def arm_kill(
+        self, first_round: int, last_round: int, armed: int | None
+    ) -> int | None:
+        """Return the updated armed-kill round: the EARLIEST scheduled
+        kill in [first_round, last_round] beats any later armed one."""
+        for r in range(first_round, last_round + 1):
+            if self.should_kill_after(r) and (armed is None or r < armed):
+                armed = r
+        return armed
+
+    def fire_armed_kill(
+        self,
+        armed: int | None,
+        durable_round: int,
+        record_durable: bool = True,
+    ) -> None:
+        """Raise :class:`SimulatedPreemption` for an armed kill once the
+        run is durably resumable past it: a checkpoint ≥ the armed round
+        exists (``durable_round``) and its record rows are flushed."""
+        if armed is not None and record_durable and durable_round >= armed:
+            raise SimulatedPreemption(
+                f"fault plan: simulated process kill after round {armed} "
+                f"(fired at durable round {durable_round})"
+            )
+
+    def poison_params(self, params: dict) -> dict:
+        """Threaded-path corruption: NaN-poison one tensor of an upload
+        (in place) — the update guard on the server must reject it."""
+        for name in sorted(params):
+            params[name] = np.full_like(np.asarray(params[name]), np.nan)
+            break
+        return params
+
+
+def apply_fault_plan(
+    plan: FaultPlan | None,
+    min_quorum: int,
+    round_number: int,
+    ids,
+    weights: np.ndarray,
+    worker_number: int | None = None,
+) -> np.ndarray:
+    """Fold one round's faults into a host-built aggregation-weight row and
+    enforce the quorum — THE chokepoint every SPMD selection path funnels
+    through (``_select_weights`` / ``_select_indices`` / the OBD phase-2
+    rows), so dense, gather, and horizon-fused programs all see the same
+    availability semantics without any new device inputs:
+
+    * dropped ids → weight 0 (exact-zero contribution; the in-program
+      ``total_weight`` renormalizes over survivors);
+    * corrupt ids → weight NaN (the in-program update guard rejects them
+      like a non-finite delta; without the guard the poison is visible);
+    * stragglers → one host-side max delay;
+    * survivors below the quorum → loud :class:`QuorumLostError` (any
+      active injection plan enforces a floor of 1 — an all-dropped round
+      would otherwise "aggregate" an empty sum).
+
+    ``ids[pos]`` names the worker each weight position refers to (None =
+    position IS the worker id).  ``worker_number`` sizes the Bernoulli
+    draws — pass the TRUE population so the dense (``n_slots``-row) and
+    gather (``s_pad``-row) paths draw the IDENTICAL fault set (the
+    dropout-parity pins depend on it).  ``weights`` is mutated in place
+    and returned.
+    """
+    injecting = plan is not None and plan.injection_active
+    if injecting:
+        worker_ids = (
+            np.asarray(ids) if ids is not None else np.arange(len(weights))
+        )
+        population = (
+            int(worker_number) if worker_number else len(worker_ids)
+        )
+        dropped = plan.dropped_clients(round_number, population)
+        corrupt = plan.corrupt_clients(round_number, population)
+        if dropped or corrupt:
+            for pos, wid in enumerate(worker_ids):
+                if not weights[pos]:
+                    continue  # unselected / padding slot
+                if int(wid) in dropped:  # dropout wins over corruption
+                    weights[pos] = 0.0
+                elif int(wid) in corrupt:
+                    weights[pos] = np.nan
+        plan.straggler_sleep(round_number, population)
+    quorum = max(int(min_quorum or 0), 1 if injecting else 0)
+    if quorum:
+        survivors = int((weights > 0).sum())  # NaN > 0 is False
+        if survivors < quorum:
+            message = (
+                f"round {round_number}: {survivors} surviving clients below "
+                f"min_client_quorum={quorum} — aborting the round loudly "
+                "instead of aggregating a degenerate cohort"
+            )
+            get_logger().error(message)
+            raise QuorumLostError(message)
+    return weights
+
+
+__all__ = [
+    "ClientFaultError",
+    "FaultPlan",
+    "QuorumLostError",
+    "SimulatedPreemption",
+    "apply_fault_plan",
+]

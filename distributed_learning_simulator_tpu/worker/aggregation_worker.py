@@ -63,6 +63,12 @@ class AggregationWorker(Client):
         self._send_parameter_diff: bool = True
         self._model_cache: ModelCache = ModelCache()
         self._keep_model_hook: KeepModelHook | None = None
+        # deterministic chaos (util/faults.py): the threaded executor's
+        # injection point is the upload boundary — the same seeded draws
+        # the SPMD sessions fold into their weight rows
+        from ..util.faults import FaultPlan
+
+        self._fault_plan = FaultPlan.from_config(self.config)
 
     def _before_training(self) -> None:
         super()._before_training()
@@ -120,7 +126,44 @@ class AggregationWorker(Client):
             self._aggregation_time, "aggregation", aggregation_impl
         )
 
+    def _inject_upload_faults(self, sent_data: Message) -> Message | None:
+        """Apply the round's FaultPlan at the upload boundary: straggle
+        (sleep), drop (upload becomes the server's ``None`` skipped-worker
+        path — the client trained, the upload was lost), or corrupt
+        (NaN-poison the payload; the server-side update guard must reject
+        it).  Returns the message to send, or None for a dropout."""
+        plan = self._fault_plan
+        if plan is None or not plan.injection_active:
+            return sent_data
+        n = self.config.worker_number
+        round_number = self._round_num
+        plan.straggler_sleep(round_number, n, worker_id=self.worker_id)
+        if self.worker_id in plan.dropped_clients(round_number, n):
+            get_logger().warning(
+                "fault plan: worker %s drops round %s upload",
+                self.worker_id,
+                round_number,
+            )
+            return None
+        if self.worker_id in plan.corrupt_clients(round_number, n):
+            get_logger().warning(
+                "fault plan: worker %s corrupts round %s upload",
+                self.worker_id,
+                round_number,
+            )
+            match sent_data:
+                case DeltaParameterMessage():
+                    plan.poison_params(sent_data.delta_parameter)
+                case ParameterMessage():
+                    plan.poison_params(sent_data.parameter)
+        return sent_data
+
     def _aggregation(self, sent_data: Message, **kwargs: Any) -> None:
+        sent_data = self._inject_upload_faults(sent_data)
+        if sent_data is None:  # injected dropout: lost upload, stay in sync
+            self.send_data_to_server(None)
+            self._get_result_from_server()
+            return
         quant_key = getattr(self.trainer, "reserved_quant_rng", None)
         if quant_key is not None and hasattr(self._endpoint, "set_quant_key"):
             # codec parity with the SPMD in-program path (fed_paq /

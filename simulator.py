@@ -11,9 +11,16 @@ import sys
 sys.path.insert(0, os.path.abspath(os.path.dirname(__file__)))
 
 from distributed_learning_simulator_tpu.config import load_config
-from distributed_learning_simulator_tpu.training import train
+from distributed_learning_simulator_tpu.training import train, train_with_recovery
 
 if __name__ == "__main__":
     config = load_config(sys.argv[1:])
-    result = train(config=config)
+    if dict(config.fault_tolerance or {}).get("auto_resume"):
+        # ++<algo>.fault_tolerance.auto_resume=True: run under the
+        # self-healing supervisor — a crashed/preempted run relaunches
+        # from its newest loadable checkpoint instead of waiting for an
+        # operator (bounded by fault_tolerance.max_restarts)
+        result = train_with_recovery(config=config)
+    else:
+        result = train(config=config)
     print(result.get("performance", {}))

@@ -15,6 +15,24 @@ for cfg in fed_avg/mnist fed_avg/imdb; do
     ++$algo.round=1 ++$algo.epoch=1 ++$algo.worker_number=2 ++$algo.debug=True
 done
 
+# fault-injection smoke (util/faults.py): a seeded FaultPlan drops ~30% of
+# clients per round and corrupts one upload; the update guard must reject
+# the poison, the quorum must hold, and the run must finish — on BOTH
+# executors (the threaded path exercises client_faults_nonfatal + the
+# server-side guard, the SPMD path the in-program mask + guard)
+for exec_mode in sequential spmd; do
+  run --config-name fed_avg/mnist.yaml \
+    ++fed_avg.round=2 ++fed_avg.epoch=1 ++fed_avg.worker_number=4 \
+    ++fed_avg.executor=$exec_mode \
+    ++fed_avg.dataset_kwargs.train_size=256 ++fed_avg.dataset_kwargs.test_size=128 \
+    ++fed_avg.fault_tolerance.seed=1 \
+    ++fed_avg.fault_tolerance.dropout_rate=0.3 \
+    ++fed_avg.fault_tolerance.corrupt_schedule.2='[0]' \
+    ++fed_avg.fault_tolerance.update_guard=True \
+    ++fed_avg.fault_tolerance.client_faults_nonfatal=True \
+    ++fed_avg.algorithm_kwargs.min_client_quorum=1
+done
+
 run --config-name fed_gnn/cs.yaml \
   ++fed_gnn.round=1 ++fed_gnn.epoch=1 ++fed_gnn.worker_number=2
 

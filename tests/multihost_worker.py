@@ -27,10 +27,19 @@ def main() -> int:
         make_mesh,
     )
 
+    from distributed_learning_simulator_tpu.config import (
+        DistributedTrainingConfig,
+    )
+
+    # the two subprocesses race to the coordinator port; a lost race is a
+    # retry, not a failed dryrun — driven through config exactly as a
+    # product bring-up script would (README "Multi-host pods")
     initialize_multihost(
         coordinator_address=coordinator,
         num_processes=num_processes,
         process_id=process_id,
+        backoff_seconds=0.5,
+        config=DistributedTrainingConfig(multihost_init_retries=2),
     )
     assert jax.process_count() == num_processes, jax.process_count()
     assert len(jax.devices()) == 4 * num_processes

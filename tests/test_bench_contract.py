@@ -94,6 +94,17 @@ def test_bench_main_prints_one_json_line(monkeypatch):
         },
     )
     monkeypatch.setattr(bench, "measure_lint", lambda: 38)
+    monkeypatch.setattr(
+        bench,
+        "measure_fault_tolerance",
+        lambda: {
+            "model": "LeNet5/MNIST",
+            "dropout_rate": bench.FT_DROPOUT_RATE,
+            "unmasked": {"rounds_per_sec": 1.0, "seconds_per_round": 1.0},
+            "masked": {"rounds_per_sec": 0.98, "seconds_per_round": 1.02},
+            "dropout_overhead_fraction": 0.02,
+        },
+    )
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     bench.main()
@@ -120,6 +131,8 @@ def test_bench_main_prints_one_json_line(monkeypatch):
         "selection",
         "obd_fusion_path",
         "obd_fusion",
+        "dropout_overhead_fraction",
+        "fault_tolerance",
         "lint_findings",
     ):
         assert field in payload, field
@@ -147,6 +160,10 @@ def test_bench_main_prints_one_json_line(monkeypatch):
     assert obd["dispatches_per_round"] < 1.0
     assert obd["speedup"] == 2.5
     assert "dense_h1" in payload["obd_fusion"]
+    # fault tolerance: the masked-vs-unmasked dropout A/B (top-level
+    # fraction mirrors the measurement's own field)
+    assert payload["dropout_overhead_fraction"] == 0.02
+    assert "masked" in payload["fault_tolerance"]
     # analyzer health: the audited jaxlint finding count (count only —
     # the per-finding detail lives in the analyzer's own JSON output)
     assert payload["lint_findings"] == 38
@@ -169,6 +186,7 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     monkeypatch.setattr(bench, "measure_round_horizon", boom)
     monkeypatch.setattr(bench, "measure_obd_horizon", boom)
     monkeypatch.setattr(bench, "measure_selection_gather", boom)
+    monkeypatch.setattr(bench, "measure_fault_tolerance", boom)
     monkeypatch.setattr(bench, "measure_lint", boom)
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
@@ -194,5 +212,9 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     assert "error" in payload["obd_fusion"]
     assert payload["obd_fusion_path"]["selection_path"] == "gather"
     assert payload["obd_fusion_path"]["dispatches_per_round"] == 0.0
+    # fault-tolerance A/B degrades to an error marker; the top-level
+    # fraction degrades to -1 (the -1/absent-never contract)
+    assert "error" in payload["fault_tolerance"]
+    assert payload["dropout_overhead_fraction"] == -1.0
     # lint count degrades to -1 (never a missing field, never a crash)
     assert payload["lint_findings"] == -1

@@ -1,7 +1,15 @@
 """Failure injection + recovery (SURVEY.md §5 "failure detection/elastic
 recovery" — the reference has NONE; the TPU-first bar is: a crashed run
 must (a) surface as an error instead of hanging and (b) resume from its
-last round checkpoint and finish the schedule)."""
+last round checkpoint and finish the schedule).
+
+The active fault-tolerance layer (util/faults.py): in-program dropout
+semantics (a dropped client contributes exact zeros and the aggregate
+renormalizes over survivors — pinned bit-exact across dense/gather and
+per-round/fused paths, and against a host-f64 survivor reference),
+quorum-gated aggregation, the device-side update guard, deterministic
+FaultPlan chaos, and the ``train_with_recovery`` auto-resume supervisor.
+"""
 
 import json
 import os
@@ -10,7 +18,8 @@ import numpy as np
 import pytest
 
 from conftest import fed_avg_config
-from distributed_learning_simulator_tpu.training import train
+from distributed_learning_simulator_tpu.training import train, train_with_recovery
+from distributed_learning_simulator_tpu.util.faults import QuorumLostError
 
 
 def make_config(save_dir: str, **overrides):
@@ -23,6 +32,39 @@ def make_config(save_dir: str, **overrides):
     )
     base.update(overrides)
     return fed_avg_config(**base)
+
+
+def _selection_config(save_dir: str, gather: bool, **overrides):
+    """8-worker/5-selected shape (1 slot/device on the test mesh, so
+    gather-vs-dense equality is structural — see test_selection_gather)."""
+    algorithm_kwargs = dict(overrides.pop("algorithm_kwargs", {}))
+    algorithm_kwargs.setdefault("random_client_number", 5)
+    algorithm_kwargs["selection_gather"] = gather
+    return make_config(
+        save_dir,
+        executor="spmd",
+        worker_number=8,
+        epoch=1,
+        dataset_kwargs={"train_size": 16 * 8, "val_size": 16, "test_size": 32},
+        algorithm_kwargs=algorithm_kwargs,
+        **overrides,
+    )
+
+
+def _assert_same_metrics(a: dict, b: dict) -> None:
+    assert set(a["performance"]) == set(b["performance"])
+    for rn in sorted(a["performance"]):
+        x, y = a["performance"][rn], b["performance"][rn]
+        assert x["test_accuracy"] == y["test_accuracy"], rn
+        assert x["test_loss"] == y["test_loss"], rn
+
+
+def _final_params(save_dir: str, round_number: int) -> dict:
+    path = os.path.join(
+        save_dir, "aggregated_model", f"round_{round_number}.npz"
+    )
+    with np.load(path) as blob:
+        return {k: blob[k] for k in blob.files}
 
 
 def test_worker_crash_surfaces_as_error(tmp_path):
@@ -130,3 +172,539 @@ def test_spmd_crash_then_resume(tmp_path):
     stat = result["performance"]
     assert set(stat) == {1, 2, 3}, sorted(stat)
     assert np.isfinite(stat[3]["test_loss"])
+
+
+# ---------------------------------------------------------------------------
+# in-program dropout: renormalized aggregation over survivors
+# ---------------------------------------------------------------------------
+
+FT_DROP = {"dropout_schedule": {2: [1, 3]}}
+
+
+def test_empty_fault_config_bit_exact(tmp_session_dir):
+    """The zero-overhead contract: an empty ``fault_tolerance`` dict (and a
+    guard-less plan) leaves the round programs and trajectories untouched
+    — params and metrics bit-identical to a config without the field."""
+    base = train(make_config("base", executor="spmd"))
+    empty = train(make_config("empty", executor="spmd", fault_tolerance={}))
+    _assert_same_metrics(base, empty)
+    pa, pb = _final_params("base", 3), _final_params("empty", 3)
+    for key in pa:
+        np.testing.assert_array_equal(pa[key], pb[key], err_msg=key)
+
+
+def test_dropout_renorm_matches_host_reference(tmp_session_dir):
+    """The acceptance pin: with an injected dropout schedule, the round's
+    renormalized aggregate equals a host-f64 weighted average computed
+    over the SURVIVORS only (the same reference-semantics accumulator the
+    fedavg parity suite uses)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_learning_simulator_tpu.native import Float64Accumulator
+    from distributed_learning_simulator_tpu.parallel.mesh import put_sharded
+    from distributed_learning_simulator_tpu.parallel.spmd import (
+        SpmdFedAvgSession,
+        scan_local_epochs,
+    )
+    from distributed_learning_simulator_tpu.training import _build_task
+
+    config = make_config(
+        "hostref",
+        executor="spmd",
+        worker_number=8,
+        epoch=1,
+        dataset_kwargs={"train_size": 256, "val_size": 32, "test_size": 32},
+        fault_tolerance={"dropout_schedule": {1: [0, 5]}},
+    )
+    ctx = _build_task(config)
+    session = SpmdFedAvgSession(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+    global_params, _ = session._init_global_params()
+    host_global = {k: np.array(v, copy=True) for k, v in global_params.items()}
+    host_weights = session._select_weights(1)  # dropout mask folded in
+    assert (host_weights[[0, 5]] == 0).all(), host_weights
+    survivors = int((host_weights > 0).sum())
+    assert survivors == 6
+    rng = jax.random.PRNGKey(config.seed)
+    _, round_rng = jax.random.split(rng)
+    client_rngs = np.asarray(
+        jax.vmap(lambda i: jax.random.fold_in(round_rng, i))(
+            jnp.arange(session.n_slots)
+        )
+    )
+    new_global, _ = session._round_fn(
+        global_params,
+        put_sharded(host_weights, session._client_sharding),
+        put_sharded(client_rngs, session._client_sharding),
+    )
+
+    def flatten(params):
+        return np.concatenate(
+            [np.asarray(v, np.float32).ravel() for v in jax.tree.leaves(params)]
+        )
+
+    spmd_flat = flatten(new_global)
+    host_data = jax.tree.map(lambda x: np.asarray(x), session._data)
+    local_fn = jax.jit(
+        lambda g, d, r: scan_local_epochs(ctx.engine, config.epoch, g, d, r)[0]
+    )
+    acc = Float64Accumulator(spmd_flat.size)
+    for c in range(session.n_slots):
+        if host_weights[c] == 0:  # dropped + padding slots contribute NOTHING
+            continue
+        slot_rng, _ = jax.random.split(jnp.asarray(client_rngs[c]))
+        slot_data = jax.tree.map(lambda x, c=c: x[c], host_data)
+        acc.add(flatten(local_fn(host_global, slot_data, slot_rng)), float(host_weights[c]))
+    ref_flat = acc.finalize()
+    rel = np.abs(spmd_flat - ref_flat).max() / np.abs(ref_flat).max()
+    assert rel <= 1e-6, f"survivor-renormalized aggregate off by {rel:.3e}"
+
+
+def test_dropout_parity_gather_vs_dense(tmp_session_dir):
+    """Dropped ids are masked out of the gather path's S_pad rows exactly
+    as they are zero-masked on the dense path: identical metrics and
+    bit-identical final params under the same injected schedule."""
+    dense = train(_selection_config("fd", False, fault_tolerance=dict(FT_DROP)))
+    gathered = train(_selection_config("fg", True, fault_tolerance=dict(FT_DROP)))
+    _assert_same_metrics(dense, gathered)
+    pa, pb = _final_params("fd", 3), _final_params("fg", 3)
+    for key in pa:
+        np.testing.assert_array_equal(pa[key], pb[key], err_msg=key)
+
+
+def test_dropout_parity_fused_horizon(tmp_session_dir):
+    """The availability mask rides the fused [H, S_pad] weight matrix:
+    H=1 and H=4 trajectories are bit-identical under the same dropout
+    schedule, and the fused dispatch budget does not regress (still ≤ 1
+    dispatch per horizon chunk plus eval)."""
+    h1 = train(
+        _selection_config("h1", True, round=4, fault_tolerance=dict(FT_DROP))
+    )
+    h4 = train(
+        _selection_config(
+            "h4",
+            True,
+            round=4,
+            fault_tolerance=dict(FT_DROP),
+            algorithm_kwargs={"round_horizon": 4},
+        )
+    )
+    _assert_same_metrics(h1, h4)
+    pa, pb = _final_params("h1", 4), _final_params("h4", 4)
+    for key in pa:
+        np.testing.assert_array_equal(pa[key], pb[key], err_msg=key)
+
+
+def test_dropout_dispatch_budget_not_regressed(tmp_session_dir):
+    """Dropout is weight masking, not a new device input: the fused
+    session still runs ONE dispatch and ONE host sync per horizon with an
+    active injection schedule."""
+    from distributed_learning_simulator_tpu.parallel.spmd import (
+        SpmdFedAvgSession,
+    )
+    from distributed_learning_simulator_tpu.training import _build_task
+
+    config = _selection_config(
+        "budget",
+        True,
+        round=4,
+        fault_tolerance=dict(FT_DROP),
+        algorithm_kwargs={"round_horizon": 4},
+    )
+    ctx = _build_task(config)
+    session = SpmdFedAvgSession(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+    session.run()
+    assert session.dispatches_per_round <= 1.0 / 4 + 1e-9
+    assert session.host_sync_points <= 1.0 / 4 + 1e-9
+
+
+@pytest.mark.slow
+def test_dropout_parity_fed_obd(tmp_session_dir):
+    """FedOBD under injected dropout: gather vs dense and H=1 vs fused
+    H=2 all agree on metrics (phase-1 selection rows AND phase-2
+    full-participation rows are masked; the opt-state merge treats a
+    dropout as a missed participation)."""
+
+    def obd_config(save_dir, gather, horizon=1):
+        algorithm_kwargs = {
+            "random_client_number": 5,
+            "selection_gather": gather,
+            "dropout_rate": 0.3,
+            "second_phase_epoch": 2,
+        }
+        if horizon != 1:
+            algorithm_kwargs["round_horizon"] = horizon
+        return make_config(
+            save_dir,
+            executor="spmd",
+            worker_number=8,
+            epoch=1,
+            round=4,
+            distributed_algorithm="fed_obd",
+            endpoint_kwargs={
+                "server": {"weight": 0.01},
+                "worker": {"weight": 0.01},
+            },
+            dataset_kwargs={
+                "train_size": 16 * 8,
+                "val_size": 16,
+                "test_size": 32,
+            },
+            algorithm_kwargs=algorithm_kwargs,
+            fault_tolerance={"dropout_schedule": {2: [0, 4], 5: [2]}},
+        )
+
+    dense = train(obd_config("od", False))
+    gathered = train(obd_config("og", True))
+    fused = train(obd_config("oh", True, horizon=2))
+    _assert_same_metrics(dense, gathered)
+    _assert_same_metrics(gathered, fused)
+
+
+# ---------------------------------------------------------------------------
+# quorum + update hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_below_quorum_aborts_loudly_spmd(tmp_session_dir):
+    with pytest.raises(QuorumLostError, match="min_client_quorum=2"):
+        train(
+            make_config(
+                "q_spmd",
+                executor="spmd",
+                worker_number=4,
+                fault_tolerance={"dropout_schedule": {2: [0, 1, 2]}},
+                algorithm_kwargs={"min_client_quorum": 2},
+            )
+        )
+
+
+def test_below_quorum_aborts_loudly_threaded(tmp_session_dir):
+    with pytest.raises(QuorumLostError, match="min_client_quorum=2"):
+        train(
+            make_config(
+                "q_seq",
+                executor="sequential",
+                worker_number=4,
+                fault_tolerance={"dropout_schedule": {2: [0, 1, 2]}},
+                algorithm_kwargs={"min_client_quorum": 2},
+            )
+        )
+
+
+def test_nonfinite_update_rejected_spmd(tmp_session_dir):
+    """A corrupt (NaN) client upload is rejected in-program: the round
+    completes finite, renormalized over the survivors, and the record row
+    counts exactly the injected rejection."""
+    result = train(
+        make_config(
+            "guard_spmd",
+            executor="spmd",
+            worker_number=4,
+            fault_tolerance={
+                "corrupt_schedule": {2: [1]},
+                "update_guard": True,
+            },
+        )
+    )
+    stat = result["performance"]
+    assert stat[1]["rejected_updates"] == 0
+    assert stat[2]["rejected_updates"] == 1
+    assert all(np.isfinite(stat[r]["test_loss"]) for r in stat)
+
+
+def test_nonfinite_update_rejected_threaded(tmp_session_dir):
+    result = train(
+        make_config(
+            "guard_seq",
+            executor="sequential",
+            worker_number=4,
+            fault_tolerance={
+                "corrupt_schedule": {2: [0]},
+                "update_guard": True,
+            },
+        )
+    )
+    stat = result["performance"]
+    assert stat[2]["rejected_updates"] == 1
+    assert all(np.isfinite(stat[r]["test_loss"]) for r in stat)
+
+
+def test_norm_guard_rejects_exploded_update(tmp_session_dir):
+    """``max_update_norm`` rejects norm-exploded (but finite) deltas: a
+    vanishingly small ceiling rejects EVERY upload — the round keeps the
+    old params in-program (``guarded_average``: an all-zero sum must not
+    zero the model) and the post-guard quorum aborts it loudly, with the
+    round's record row counting all worker_number rejections."""
+    with pytest.raises(QuorumLostError, match="after update-guard"):
+        train(
+            make_config(
+                "norm_spmd",
+                executor="spmd",
+                worker_number=4,
+                round=2,
+                fault_tolerance={"max_update_norm": 1e-12},
+            )
+        )
+    with open(
+        os.path.join("norm_spmd", "server", "round_record.json"),
+        encoding="utf8",
+    ) as f:
+        record = json.load(f)
+    assert record["1"]["rejected_updates"] == 4
+    assert np.isfinite(record["1"]["test_loss"])
+
+
+def test_kill_on_sparse_checkpoint_cadence_defers(tmp_session_dir):
+    """A kill scheduled on a round without a checkpoint (sparse
+    ``checkpoint_every``) DEFERS to the next durable boundary — otherwise
+    every resume would re-execute the killed round, re-fire the stateless
+    kill, and deterministically exhaust the supervisor's retry budget."""
+    result = train_with_recovery(
+        make_config(
+            "sparse_kill",
+            executor="spmd",
+            round=4,
+            checkpoint_every=2,
+            fault_tolerance={
+                "kill_after_rounds": [3],  # round 3 is never checkpointed
+                "restart_backoff_seconds": 0.0,
+            },
+        )
+    )
+    assert set(result["performance"]) == {1, 2, 3, 4}
+    assert result["recovery"]["restarts"] == 1
+
+
+def test_worker_crash_nonfatal_becomes_dropout(tmp_session_dir):
+    """``client_faults_nonfatal``: a crashed worker thread is demoted to a
+    permanent dropout — every remaining round completes over the
+    survivors, and the record rows count the dead client."""
+    from distributed_learning_simulator_tpu.worker.aggregation_worker import (
+        AggregationWorker,
+    )
+
+    original = AggregationWorker._get_sent_data
+
+    def faulty(self):
+        if self.worker_id == 1 and self._round_num >= 2:
+            raise RuntimeError("injected client fault")
+        return original(self)
+
+    AggregationWorker._get_sent_data = faulty
+    try:
+        result = train(
+            make_config(
+                "nonfatal",
+                executor="sequential",
+                worker_number=4,
+                fault_tolerance={"client_faults_nonfatal": True},
+            )
+        )
+    finally:
+        AggregationWorker._get_sent_data = original
+    stat = result["performance"]
+    assert set(stat) == {1, 2, 3}
+    assert stat[1]["dropped_clients"] == 0
+    assert stat[2]["dropped_clients"] == 1
+    assert stat[3]["dropped_clients"] == 1
+
+
+# ---------------------------------------------------------------------------
+# deterministic FaultPlan + auto-resume supervisor
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_deterministic_and_strict():
+    from distributed_learning_simulator_tpu.util.faults import FaultPlan
+
+    class Cfg:
+        fault_tolerance = {
+            "seed": 7,
+            "dropout_rate": 0.3,
+            "corrupt_schedule": {"4": [2]},
+        }
+
+    a, b = FaultPlan.from_config(Cfg()), FaultPlan.from_config(Cfg())
+    for rn in range(1, 6):
+        assert a.dropped_clients(rn, 16) == b.dropped_clients(rn, 16)
+    assert a.corrupt_clients(4, 16) == frozenset({2})  # str keys normalized
+    assert a.injection_active
+
+    class Empty:
+        fault_tolerance = {}
+
+    assert FaultPlan.from_config(Empty()) is None
+
+    class Unknown:
+        fault_tolerance = {"droput_rate": 0.5}  # typo'd knob
+
+    with pytest.raises(ValueError, match="unknown fault_tolerance"):
+        FaultPlan.from_config(Unknown())
+
+
+def test_train_with_recovery_kill_twice_finishes_schedule(tmp_session_dir):
+    """The acceptance e2e: a run killed TWICE by the FaultPlan finishes
+    its full schedule under train_with_recovery, and the final attempt's
+    round_record.json covers every round exactly once."""
+    result = train_with_recovery(
+        make_config(
+            "supervised",
+            executor="spmd",
+            round=4,
+            fault_tolerance={
+                "kill_after_rounds": [1, 3],
+                "restart_backoff_seconds": 0.0,
+            },
+        )
+    )
+    assert set(result["performance"]) == {1, 2, 3, 4}
+    assert result["recovery"]["restarts"] == 2
+    record_path = os.path.join(
+        result["recovery"]["save_dir"], "server", "round_record.json"
+    )
+    with open(record_path, encoding="utf8") as f:
+        record = json.load(f)
+    assert sorted(int(k) for k in record) == [1, 2, 3, 4]
+    for row in record.values():
+        assert np.isfinite(row["test_loss"])
+
+
+def test_train_with_recovery_threaded_executor(tmp_session_dir):
+    result = train_with_recovery(
+        make_config(
+            "supervised_seq",
+            executor="sequential",
+            fault_tolerance={
+                "kill_after_rounds": [2],
+                "restart_backoff_seconds": 0.0,
+            },
+        )
+    )
+    assert set(result["performance"]) == {1, 2, 3}
+    assert result["recovery"]["restarts"] == 1
+
+
+def test_train_with_recovery_gives_up_after_budget(tmp_session_dir):
+    """A fault the supervisor cannot heal (it re-fires every attempt)
+    propagates unchanged once max_restarts is exhausted."""
+    calls = []
+    with pytest.raises(QuorumLostError):
+        train_with_recovery(
+            make_config(
+                "hopeless",
+                executor="spmd",
+                worker_number=4,
+                fault_tolerance={
+                    "dropout_schedule": {2: [0, 1, 2, 3]},
+                    "max_restarts": 1,
+                    "restart_backoff_seconds": 5.0,
+                },
+            ),
+            sleep_fn=calls.append,
+        )
+    assert calls == [5.0]  # one backoff for the one allowed restart
+
+
+def test_resume_skips_torn_checkpoint(tmp_session_dir):
+    """Resume integrity fallback: an unloadable newest round_N.npz logs
+    and falls back to the previous checkpointed round instead of crashing
+    the recovering run."""
+    from distributed_learning_simulator_tpu.util.resume import (
+        load_resume_state,
+        resumable_round,
+    )
+
+    train(make_config("torn", executor="spmd"))
+    path = os.path.join("torn", "aggregated_model", "round_3.npz")
+    with open(path, "rb") as f:
+        blob = f.read()
+    with open(path, "wb") as f:
+        f.write(blob[: len(blob) // 2])  # torn write
+    assert resumable_round("torn") == 2
+    params, stats, last = load_resume_state("torn")
+    assert last == 2 and params is not None
+    assert sorted(stats) == [1, 2]
+    # a resumed run recomputes round 3 from the round-2 model
+    result = train(
+        make_config(
+            "torn_resume",
+            executor="spmd",
+            algorithm_kwargs={"resume_dir": "torn"},
+        )
+    )
+    assert set(result["performance"]) == {1, 2, 3}
+
+
+def test_copy_last_to_before_save_raises():
+    from distributed_learning_simulator_tpu.util.checkpoint import (
+        AsyncCheckpointWriter,
+        CheckpointError,
+    )
+
+    with pytest.raises(CheckpointError, match="before any save_npz"):
+        AsyncCheckpointWriter().copy_last_to("nowhere.npz")
+
+
+def test_multihost_init_retries_and_diagnostic(monkeypatch):
+    """initialize_multihost retries a failed explicit-cluster join with
+    backoff and raises a diagnostic naming the unreachable coordinator."""
+    import jax
+
+    from distributed_learning_simulator_tpu.parallel import mesh
+
+    attempts = []
+
+    def failing_initialize(coordinator_address, num_processes, process_id):
+        attempts.append(coordinator_address)
+        raise RuntimeError("connection refused")
+
+    monkeypatch.setattr(jax.distributed, "initialize", failing_initialize)
+    monkeypatch.setattr(
+        jax.distributed, "is_initialized", lambda: False, raising=False
+    )
+    with pytest.raises(RuntimeError, match="10.0.0.99:8476 unreachable"):
+        mesh.initialize_multihost(
+            coordinator_address="10.0.0.99:8476",
+            num_processes=2,
+            process_id=0,
+            retries=2,
+            backoff_seconds=0.0,
+        )
+    assert len(attempts) == 3  # first try + 2 retries
+
+
+def test_straggler_delay_is_deterministic(monkeypatch):
+    """Straggler injection: scheduled workers sleep the configured delay
+    (threaded flavor: per worker; SPMD flavor: one max-delay per round),
+    non-stragglers and non-scheduled rounds do not."""
+    from distributed_learning_simulator_tpu.util import faults
+
+    naps = []
+    monkeypatch.setattr(faults.time, "sleep", naps.append)
+
+    class Cfg:
+        fault_tolerance = {
+            "straggler_schedule": {2: [1]},
+            "straggler_delay_seconds": 0.25,
+        }
+
+    plan = faults.FaultPlan.from_config(Cfg())
+    plan.straggler_sleep(1, 4, worker_id=1)  # round 1: nobody straggles
+    plan.straggler_sleep(2, 4, worker_id=0)  # round 2: worker 0 doesn't
+    assert naps == []
+    plan.straggler_sleep(2, 4, worker_id=1)  # the scheduled straggler
+    plan.straggler_sleep(2, 4)  # SPMD flavor: any straggler -> one delay
+    assert naps == [0.25, 0.25]
