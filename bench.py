@@ -348,11 +348,53 @@ OBD_HORIZON = 4
 OBD_BATCH = 32
 
 
+def _fused_session_ab(out, horizon, build_config, build_session) -> dict:
+    """THE dense/H=1 vs gather/H fused full-session A/B, shared by the
+    client-axis (`measure_obd_horizon`) and whole-mesh
+    (`measure_ep_fusion`) measurements: per arm, build the config/session,
+    run once for compile warmup, rerun timed with reset counters, and
+    record rounds/sec + the session's dispatch/host-sync counters +
+    selection-path facts; finish with the fused-vs-dense speedup."""
+    from distributed_learning_simulator_tpu.training import _build_task
+
+    fused_key = f"gather_h{horizon}"
+    for arm, (gather, arm_horizon) in (
+        ("dense_h1", (False, 1)),
+        (fused_key, (True, horizon)),
+    ):
+        config = build_config(arm, gather, arm_horizon)
+        ctx = _build_task(config)
+        session = build_session(ctx)
+        session.run()  # warmup: compiles the phase/horizon programs
+        session._stat.clear()
+        session.reset_dispatch_stats()
+        start = time.monotonic()
+        session.run()
+        elapsed = time.monotonic() - start
+        rounds = session.rounds_run or 1
+        out[arm] = {
+            "rounds_per_sec": round(rounds / elapsed, 4),
+            "dispatches_per_round": round(session.dispatches_per_round, 4),
+            "host_sync_points": round(session.host_sync_points, 4),
+            "selection_path": "gather" if session._selection_gather else "dense",
+            "s_pad": session.s_pad,
+            "wasted_compute_fraction": round(
+                session.wasted_compute_fraction, 4
+            ),
+        }
+    dense = out["dense_h1"]
+    fused = out[fused_key]
+    if dense["rounds_per_sec"]:
+        out["speedup"] = round(
+            fused["rounds_per_sec"] / dense["rounds_per_sec"], 3
+        )
+    return out
+
+
 def measure_obd_horizon() -> dict:
     from distributed_learning_simulator_tpu.parallel.spmd_obd import (
         SpmdFedOBDSession,
     )
-    from distributed_learning_simulator_tpu.training import _build_task
 
     out: dict = {
         "model": "densenet40/CIFAR10",
@@ -362,11 +404,9 @@ def measure_obd_horizon() -> dict:
         "second_phase_epoch": OBD_PHASE2,
         "horizon": OBD_HORIZON,
     }
-    for arm, (gather, horizon) in (
-        ("dense_h1", (False, 1)),
-        (f"gather_h{OBD_HORIZON}", (True, OBD_HORIZON)),
-    ):
-        config = make_config(
+
+    def build_config(arm, gather, horizon):
+        return make_config(
             "spmd",
             OBD_WORKERS,
             OBD_WORKERS * OBD_BATCH,
@@ -386,38 +426,103 @@ def measure_obd_horizon() -> dict:
                 "round_horizon": horizon,
             },
         )
-        ctx = _build_task(config)
-        session = SpmdFedOBDSession(
+
+    def build_session(ctx):
+        return SpmdFedOBDSession(
             ctx.config,
             ctx.dataset_collection,
             ctx.model_ctx,
             ctx.engine,
             ctx.practitioners,
         )
-        session.run()  # warmup: compiles the phase/horizon programs
-        session._stat.clear()
-        session.reset_dispatch_stats()
-        start = time.monotonic()
-        session.run()
-        elapsed = time.monotonic() - start
-        rounds = session.rounds_run or 1
-        out[arm] = {
-            "rounds_per_sec": round(rounds / elapsed, 4),
-            "dispatches_per_round": round(session.dispatches_per_round, 4),
-            "host_sync_points": round(session.host_sync_points, 4),
-            "selection_path": "gather" if session._selection_gather else "dense",
-            "s_pad": session.s_pad,
-            "wasted_compute_fraction": round(
-                session.wasted_compute_fraction, 4
-            ),
-        }
-    dense = out["dense_h1"]
-    fused = out[f"gather_h{OBD_HORIZON}"]
-    if dense["rounds_per_sec"]:
-        out["speedup"] = round(
-            fused["rounds_per_sec"] / dense["rounds_per_sec"], 3
+
+    return _fused_session_ab(out, OBD_HORIZON, build_config, build_session)
+
+
+# Whole-mesh fused-round A/B (PR 8): the expert-parallel FedOBD session —
+# the flagship model-sharded workload — gets the same dense/H=1 vs
+# gather/H=EP_HORIZON full-session A/B measure_obd_horizon runs for the
+# client-axis layout, driving the ep session's own run loop so the
+# dispatch_count/host_sync_count counters certify <1 dispatch/round and
+# ≤1 host sync per horizon on the whole-mesh scan layout too.  A small
+# MoE shape keeps the dense arm benchable on CPU hosts; expert_parallel
+# adapts to the local device count (largest divisor of n_experts).
+EP_WORKERS = 8
+EP_SELECTED = 4
+EP_ROUNDS = 4
+EP_PHASE2 = 2
+EP_HORIZON = 4
+EP_BATCH = 8
+EP_EXPERTS = 4
+EP_MAX_LEN = 64
+
+
+def measure_ep_fusion() -> dict:
+    import jax
+
+    from distributed_learning_simulator_tpu.parallel.spmd_obd_ep import (
+        SpmdFedOBDExpertParallelSession,
+    )
+
+    expert_parallel = max(
+        d
+        for d in (EP_EXPERTS, EP_EXPERTS // 2, 1)
+        if d and d <= len(jax.devices())
+    )
+    out: dict = {
+        "model": "MoETransformer/imdb",
+        "workers": EP_WORKERS,
+        "selected_per_round": EP_SELECTED,
+        "rounds": EP_ROUNDS,
+        "second_phase_epoch": EP_PHASE2,
+        "horizon": EP_HORIZON,
+        "expert_parallel": expert_parallel,
+    }
+
+    def build_config(arm, gather, horizon):
+        return make_config(
+            "spmd",
+            EP_WORKERS,
+            EP_WORKERS * EP_BATCH * 2,
+            model_name="MoETransformerClassificationModel",
+            batch_size=EP_BATCH,
+            tag=f"ep_{arm}",
+            dataset_name="imdb",
+            dataset_extra={"max_len": EP_MAX_LEN},
+            rounds=EP_ROUNDS,
+            distributed_algorithm="fed_obd",
+            endpoint_kwargs={
+                "server": {"weight": 0.01},
+                "worker": {"weight": 0.01},
+            },
+            algorithm_kwargs={
+                "dropout_rate": 0.3,
+                "second_phase_epoch": EP_PHASE2,
+                "random_client_number": EP_SELECTED,
+                "selection_gather": gather,
+                "round_horizon": horizon,
+            },
+            model_kwargs={
+                "d_model": 64,
+                "nhead": 4,
+                "num_encoder_layer": 2,
+                "n_experts": EP_EXPERTS,
+                "max_len": EP_MAX_LEN,
+                "expert_parallel": expert_parallel,
+            },
         )
-    return out
+
+    def build_session(ctx):
+        return SpmdFedOBDExpertParallelSession(
+            ctx.config,
+            ctx.dataset_collection,
+            ctx.model_ctx,
+            ctx.engine,
+            ctx.practitioners,
+            expert_parallel=expert_parallel,
+        )
+
+    return _fused_session_ab(out, EP_HORIZON, build_config, build_session)
 
 
 # selection-aware gather A/B (the 1000-client / 100-selected LeNet shape):
@@ -890,6 +995,14 @@ def main() -> None:
     except Exception as exc:
         obd_fusion = {"error": str(exc)[:200]}
     obd_fused = obd_fusion.get(f"gather_h{OBD_HORIZON}", {})
+    # whole-mesh fused rounds (PR 8): the expert-parallel FedOBD session's
+    # dense/H=1 vs gather/H≥4 full session.run A/B — the model-sharded
+    # flagship gets the same dispatch-amortization certificate
+    try:
+        ep_fusion = measure_ep_fusion()
+    except Exception as exc:
+        ep_fusion = {"error": str(exc)[:200]}
+    ep_fused = ep_fusion.get(f"gather_h{EP_HORIZON}", {})
     # fault-tolerance A/B: masked (FaultPlan dropout) vs unmasked round
     # wall time — the availability mask must be free (it rides the weight
     # rows the rounds already consume)
@@ -985,6 +1098,25 @@ def main() -> None:
                     "speedup": obd_fusion.get("speedup", 0.0),
                 },
                 "obd_fusion": obd_fusion,
+                # whole-mesh fusion: the expert-parallel FedOBD session's
+                # fused-arm dispatch budget (gather + < 1 dispatch/round
+                # on the whole-mesh-per-client scan layout); the dense
+                # arm and the speedup live under ep_fusion (-1/absent-
+                # never: the fields always print, 0.0/error on failure)
+                "ep_fusion_path": {
+                    "selection_path": ep_fused.get(
+                        "selection_path", "gather"
+                    ),
+                    "horizon": ep_fusion.get("horizon", EP_HORIZON),
+                    "dispatches_per_round": ep_fused.get(
+                        "dispatches_per_round", 0.0
+                    ),
+                    "host_sync_points": ep_fused.get(
+                        "host_sync_points", 0.0
+                    ),
+                    "speedup": ep_fusion.get("speedup", 0.0),
+                },
+                "ep_fusion": ep_fusion,
                 # fault tolerance: masked-vs-unmasked round wall time
                 # (dropout_overhead_fraction ≈ 0 is the design goal; -1 =
                 # the measurement failed, the field itself never goes

@@ -120,7 +120,14 @@ class ShapleyValueAlgorithm(FedAVGAlgorithm):
         test = self._server.tester.dataset_collection.get_dataset(Phase.Test)
         batches = make_epoch_batches(test, self.config.batch_size)
 
-        chunk = 16  # bound live memory at chunk × model params
+        # subset-eval chunk: bound live memory at chunk × model params.
+        # ``algorithm_kwargs.sv_batch_chunk`` trades HBM for fewer
+        # dispatches on large-player rounds (2^N − 1 subsets): a bigger
+        # chunk evaluates more masks per compiled program; the default
+        # keeps the historical 16.
+        chunk = max(
+            1, int(self.config.algorithm_kwargs.get("sv_batch_chunk", 16) or 16)
+        )
 
         # stacked params / test batches enter as arguments — closing over
         # them would bake the arrays into the HLO as constants

@@ -20,6 +20,7 @@ The host keeps the reference's control surface: per-round selection,
 round_record.json, best-model artifact, early stop.
 """
 
+import contextlib
 import os
 
 import jax
@@ -311,6 +312,8 @@ def scan_weighted_clients(
     rngs,
     metrics_shape,
     val_data=None,
+    guard_active: bool = False,
+    max_update_norm: float = 0.0,
 ):
     """Clients one after another as a ``lax.scan`` (the round body of the
     whole-mesh-per-client sessions, ``spmd_sp.py``/``spmd_ep.py``), with
@@ -318,7 +321,15 @@ def scan_weighted_clients(
     training even when no codec is configured, so trajectories match the
     client-axis session's to float order (the equivalence tests pin it).
     Unselected clients flow through masked to weight 0 — SPMD needs a
-    uniform program.  Returns (weighted-average params, summed metrics)."""
+    uniform program.  Returns (weighted-average params, summed metrics).
+
+    ``guard_active`` compiles the shared update guard
+    (:func:`guard_client_update`) into the scan body: a rejected client's
+    effective weight is exactly zero, the total weight accumulates over
+    SURVIVORS alongside the params, a zero-survivor round keeps the old
+    global (:func:`guarded_average`), and the summed metrics gain the
+    ``rejected_updates`` count — the same semantics the client-axis
+    shard bodies compile in."""
 
     def body(acc, xs):
         cdata, cval, weight, rng = xs
@@ -327,16 +338,29 @@ def scan_weighted_clients(
             engine, epochs, global_params, cdata, rng,
             val_data=cval if cval else None,
         )
-        acc_params, acc_metrics = acc
+        # train-metric mask from the PRE-guard weight (the dense path's
+        # selection flag); the guard's reject count rides separately,
+        # unmasked, so a rejected participant is still counted
+        selected = (weight > 0).astype(jnp.float32)
+        if guard_active:
+            acc_params, acc_metrics, acc_w, acc_rej = acc
+            weight, summed = guard_client_update(
+                params, global_params, weight, summed, max_update_norm
+            )
+            acc_w = acc_w + summed.pop("_eff_weight")
+            acc_rej = acc_rej + summed.pop("rejected_updates")
+        else:
+            acc_params, acc_metrics = acc
         acc_params = jax.tree.map(
             lambda a, p: a + p.astype(jnp.float32) * weight,
             acc_params,
             params,
         )
-        selected = (weight > 0).astype(jnp.float32)
         acc_metrics = jax.tree.map(
             lambda a, m: a + m * selected, acc_metrics, summed
         )
+        if guard_active:
+            return (acc_params, acc_metrics, acc_w, acc_rej), None
         return (acc_params, acc_metrics), None
 
     # accumulator shapes come from the params ACTUALLY in scope — under a
@@ -348,11 +372,19 @@ def scan_weighted_clients(
     zero_metrics = jax.tree.map(
         lambda s: jnp.zeros(s.shape, s.dtype), metrics_shape
     )
-    (acc_params, metrics), _ = jax.lax.scan(
+    init = (zero_params, zero_metrics)
+    if guard_active:
+        init = init + (jnp.float32(0.0), jnp.float32(0.0))
+    carry, _ = jax.lax.scan(
         body,
-        (zero_params, zero_metrics),
+        init,
         (data, val_data if val_data else {}, weights, rngs),
     )
+    if guard_active:
+        acc_params, metrics, total, rejected = carry
+        new_global = guarded_average(acc_params, total, global_params)
+        return new_global, dict(metrics, rejected_updates=rejected)
+    acc_params, metrics = carry
     total = jnp.maximum(jnp.sum(weights), 1e-12)
     new_global = jax.tree.map(
         lambda a, g: (a / total).astype(g.dtype), acc_params, global_params
@@ -373,6 +405,15 @@ class SpmdFedAvgSession:
     #: iid best-of-round upload policy) — subclasses with their own round
     #: programs that ignore it opt out so __init__ skips the stack+put
     _uses_val_policy = True
+
+    #: capability flag for the whole-mesh-per-client family (ep/sp/pp —
+    #: the whole mesh to ONE client at a time, clients as a ``lax.scan``):
+    #: subclasses that route their round programs through
+    #: :meth:`_wrap_round_programs` set this True, which unlocks the
+    #: selection-aware gather, round-horizon fusion, and the device-side
+    #: update guard on their layouts.  Bespoke sessions (GNN, sparse,
+    #: Shapley, smafd) leave it False and the knobs keep rejecting loudly.
+    _whole_mesh_fused = False
 
     def __init__(
         self,
@@ -652,9 +693,10 @@ class SpmdFedAvgSession:
 
     def _selection_gather_unsupported_reason(self) -> str | None:
         """Why this session cannot run the selection-aware gather (None =
-        supported).  Sessions that extend the gather to their own round
-        programs (FedOBD) override this."""
-        if type(self) is not SpmdFedAvgSession:
+        supported).  Whole-mesh-per-client subclasses declare support via
+        ``_whole_mesh_fused``; sessions that extend the gather to their
+        own round programs (FedOBD) override this."""
+        if type(self) is not SpmdFedAvgSession and not self._whole_mesh_fused:
             return f"{type(self).__name__} builds its own round program"
         if self._fsdp:
             return (
@@ -673,11 +715,20 @@ class SpmdFedAvgSession:
 
     def _update_guard_unsupported_reason(self) -> str | None:
         """Why this session cannot compile the device-side update guard
-        into its round program (None = supported).  Sessions that extend
-        the guard to their own round programs (FedOBD) override this."""
-        if type(self) is not SpmdFedAvgSession:
+        into its round program (None = supported).  Whole-mesh-per-client
+        subclasses declare support via ``_whole_mesh_fused``; sessions
+        that extend the guard to their own round programs (FedOBD)
+        override this."""
+        if type(self) is not SpmdFedAvgSession and not self._whole_mesh_fused:
             return f"{type(self).__name__} builds its own round program"
         return None
+
+    def _round_mesh_context(self):
+        """Ambient-mesh context wrapping every program trace/dispatch —
+        the expert-parallel layouts override with ``use_mesh`` so
+        bare-``PartitionSpec`` constraints inside their models resolve
+        (jax 0.4 compat: ``mesh.py::use_mesh``)."""
+        return contextlib.nullcontext()
 
     def _maybe_kill(self, first_round: int, last_round: int | None = None) -> None:
         """Arm any FaultPlan-scheduled process kill in the (inclusive)
@@ -953,18 +1004,32 @@ class SpmdFedAvgSession:
                 out_specs=(self._param_specs, P()),
             )(global_params, data, val, weights, rngs)
 
+        return self._wrap_round_programs(round_program)
+
+    def _wrap_round_programs(self, round_program, out_shardings=None):
+        """The shared tail of every fusable ``_build_round_fn`` (the base
+        client-axis session AND the whole-mesh ep/sp/pp subclasses):
+        register the un-jitted ``(global_params, weights, rngs, data, val)``
+        program for the horizon builder, jit the dense path, build + jit
+        the gather twin when the selection gather is active, and return
+        the dispatch fn.  ``out_shardings`` pins the jitted outputs to a
+        stored layout (the expert-parallel session's donated
+        round-over-round buffers must never reshard)."""
         # the horizon builder scans this same program — one trace, shared
         # numerics with the per-round path
         self._round_program_fn = round_program
+        jit_kwargs = (
+            {"out_shardings": out_shardings} if out_shardings is not None else {}
+        )
         # donate the old global params: the round returns the new ones, so
         # XLA can reuse the buffer instead of holding both copies live
-        jitted = jax.jit(round_program, donate_argnums=(0,))
+        jitted = jax.jit(round_program, donate_argnums=(0,), **jit_kwargs)
         # bench introspection handle (compiled memory analysis — the
         # tunneled axon platform returns no runtime memory_stats)
         self._jitted_round_fn = jitted
 
         if self._selection_gather:
-            client_sharding = self._client_sharding
+            session = self
 
             def gather_round_program(
                 global_params, weights, rngs, sel_idx, data, val
@@ -972,40 +1037,51 @@ class SpmdFedAvgSession:
                 """The SAME round program over a gathered ``[s_pad]`` slot
                 stack: a device-side ``jnp.take`` along the slot axis (the
                 full ``[C, ...]`` client stack stays resident — no host
-                restaging), then the identical ``shard_map`` body over
-                ``s_pad`` slots instead of ``n_slots``."""
+                restaging), then the identical round body over ``s_pad``
+                slots instead of ``n_slots``.  Each gathered leaf is
+                constrained back to ITS OWN stored sharding (trace-time
+                read of the resident stacks): the client axis on
+                client-axis meshes, the sequence axis on the sp layout —
+                a whole-mesh session's take must not re-replicate
+                sequence-sharded data."""
 
-                def take(x):
-                    return jax.lax.with_sharding_constraint(
-                        jnp.take(x, sel_idx, axis=0), client_sharding
+                def take(tree, stored):
+                    shardings = jax.tree.map(lambda x: x.sharding, stored)
+                    return jax.tree.map(
+                        lambda x, s: jax.lax.with_sharding_constraint(
+                            jnp.take(x, sel_idx, axis=0), s
+                        ),
+                        tree,
+                        shardings,
                     )
 
                 return round_program(
                     global_params,
                     weights,
                     rngs,
-                    jax.tree.map(take, data),
-                    jax.tree.map(take, val),
+                    take(data, session._data),
+                    take(val, session._val_data or {}),
                 )
 
             self._gather_program_fn = gather_round_program
             self._jitted_gather_round_fn = jax.jit(
-                gather_round_program, donate_argnums=(0,)
+                gather_round_program, donate_argnums=(0,), **jit_kwargs
             )
 
         def fn(global_params, weights, rngs, sel_idx=None):
-            if sel_idx is not None:
-                return self._jitted_gather_round_fn(
-                    global_params,
-                    weights,
-                    rngs,
-                    sel_idx,
-                    self._data,
-                    self._val_data or {},
+            with self._round_mesh_context():
+                if sel_idx is not None:
+                    return self._jitted_gather_round_fn(
+                        global_params,
+                        weights,
+                        rngs,
+                        sel_idx,
+                        self._data,
+                        self._val_data or {},
+                    )
+                return jitted(
+                    global_params, weights, rngs, self._data, self._val_data or {}
                 )
-            return jitted(
-                global_params, weights, rngs, self._data, self._val_data or {}
-            )
 
         return fn
 
@@ -1062,18 +1138,28 @@ class SpmdFedAvgSession:
             )
             return (global_params, rng), outs
 
-        jitted = jax.jit(horizon_program, donate_argnums=(0, 1))
+        # the params carry keeps the stored per-leaf layout (replicated,
+        # FSDP-sharded, or the ep expert layout) so the donated
+        # round-over-round buffers never reshard — without the pin a
+        # GSPMD session's second chunk could see differently-laid-out
+        # inputs and retrace
+        jitted = jax.jit(
+            horizon_program,
+            donate_argnums=(0, 1),
+            out_shardings=((self._param_shardings, None), None),
+        )
 
         def fn(global_params, rng, weight_rows, idx_rows=None):
-            return jitted(
-                global_params,
-                rng,
-                weight_rows,
-                idx_rows,
-                self._data,
-                self._val_data or {},
-                self._ensure_eval_batches(),
-            )
+            with self._round_mesh_context():
+                return jitted(
+                    global_params,
+                    rng,
+                    weight_rows,
+                    idx_rows,
+                    self._data,
+                    self._val_data or {},
+                    self._ensure_eval_batches(),
+                )
 
         fn._jitted = jitted
         return fn

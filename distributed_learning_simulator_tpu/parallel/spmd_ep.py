@@ -36,6 +36,11 @@ from .spmd import (
 
 
 class SpmdExpertParallelSession(SpmdFedAvgSession):
+    #: whole-mesh layout routed through the shared fused-round machinery:
+    #: selection gather, round-horizon fusion and the update guard all
+    #: apply (spmd.py::_wrap_round_programs)
+    _whole_mesh_fused = True
+
     def __init__(
         self,
         config,
@@ -98,37 +103,33 @@ class SpmdExpertParallelSession(SpmdFedAvgSession):
             return P("ep", None, None)
         return P()
 
+    def _round_mesh_context(self):
+        # bare-PartitionSpec sharding constraints inside the MoE model
+        # resolve against the ambient mesh (version-compat helper: jax
+        # 0.4 has no jax.sharding.set_mesh)
+        return use_mesh(self.mesh)
+
     def _build_round_fn(self):
         engine = self._ep_engine
         epochs = self.config.epoch
-        mesh = self.mesh
+        guard_active = self._update_guard
+        max_update_norm = self._max_update_norm
         _, metrics_shape = whole_mesh_session_shapes(self)
 
         def round_program(global_params, weights, rngs, data, val):
             return scan_weighted_clients(
                 engine, epochs, global_params, data, weights, rngs,
                 metrics_shape, val_data=val if val else None,
+                guard_active=guard_active, max_update_norm=max_update_norm,
             )
 
         # out_shardings pin the new globals to the stored expert layout so
-        # the donated round-over-round buffers never reshard
-        jitted = jax.jit(
-            round_program,
-            donate_argnums=(0,),
-            out_shardings=(self._param_shardings, None),
+        # the donated round-over-round buffers never reshard; the gather
+        # twin, horizon builder and dispatch fn (all under use_mesh via
+        # _round_mesh_context) come from the shared machinery
+        return self._wrap_round_programs(
+            round_program, out_shardings=(self._param_shardings, None)
         )
-
-        def fn(global_params, weights, rngs):
-            # bare-PartitionSpec sharding constraints inside the MoE model
-            # resolve against the ambient mesh (version-compat helper: jax
-            # 0.4 has no jax.sharding.set_mesh)
-            with use_mesh(mesh):
-                return jitted(
-                    global_params, weights, rngs, self._data,
-                    self._val_data or {},
-                )
-
-        return fn
 
 
 def build_expert_parallel_session(ctx, session_args, session_kwargs):

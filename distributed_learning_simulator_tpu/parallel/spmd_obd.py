@@ -103,12 +103,18 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         self._stream_slots = self.n_slots
         # per-round client keys for the gather path: rows of the SAME
         # full-population split the dense path uses, taken at the
-        # selected ids device-side
+        # selected ids device-side.  ``_stream_slots`` is read at TRACE
+        # time (first dispatch), not here: the ep/sp subclasses override
+        # it to the default-mesh count AFTER this __init__ returns, and
+        # capturing the client-axis value would silently diverge their
+        # gather stream from the dense path's.
         if self._selection_gather:
-            stream_slots = self._stream_slots
+            session = self
             self._split_sel_rngs = jax.jit(
                 lambda round_rng, sel_idx: jnp.take(
-                    jax.random.split(round_rng, stream_slots), sel_idx, axis=0
+                    jax.random.split(round_rng, session._stream_slots),
+                    sel_idx,
+                    axis=0,
                 ),
                 out_shardings=self._client_sharding,
             )
@@ -124,13 +130,21 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
 
     @property
     def _phase1_carries_opt(self) -> bool:
-        """Phase-1 programs carry/merge the opt-state buffer only on the
-        client-axis session — the ep/sp subclasses keep the legacy
-        last-round-overwrites semantics their equivalence pins assume."""
-        return self._obd_selection_active and type(self) is SpmdFedOBDSession
+        """Whether phase-1 programs carry + participation-merge the
+        per-slot opt-state buffer: under an ACTIVE selection every OBD
+        layout does (client-axis AND the whole-mesh ep/sp scans), so a
+        slot's phase-2 seed is the state from its last participation and
+        the dense/gather paths agree on it bit-exactly.  Full
+        participation keeps the legacy carry-less semantics (every slot
+        trains every round; the last round's states seed phase 2)."""
+        return self._obd_selection_active and (
+            type(self) is SpmdFedOBDSession or self._whole_mesh_fused
+        )
 
     def _selection_gather_unsupported_reason(self) -> str | None:
-        if type(self) is not SpmdFedOBDSession:
+        # the ep/sp whole-mesh scans route their phase programs through
+        # _finish_obd_phase_fn and support the gather (``_whole_mesh_fused``)
+        if type(self) is not SpmdFedOBDSession and not self._whole_mesh_fused:
             return (
                 f"{type(self).__name__} lays clients out as a"
                 " whole-mesh-per-client scan (own phase programs)"
@@ -138,21 +152,30 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         return None
 
     def _horizon_capable(self) -> bool:
-        # the client-axis OBD session fuses same-phase rounds; the
-        # expert-/sequence-parallel subclasses keep their own per-round
-        # programs and reject the knob loudly (base __init__ raises)
-        return type(self) is SpmdFedOBDSession
+        # every OBD layout whose phase programs flow through
+        # _finish_obd_phase_fn fuses same-phase rounds (the client-axis
+        # session and the ep/sp whole-mesh scans)
+        return type(self) is SpmdFedOBDSession or self._whole_mesh_fused
 
     def _update_guard_unsupported_reason(self) -> str | None:
-        # the client-axis phase programs compile the guard in (per-client
-        # upload hygiene + survivor-renormalized total); the ep/sp
-        # subclasses keep their own whole-mesh-per-client programs
-        if type(self) is not SpmdFedOBDSession:
+        # the phase programs compile the guard in (per-client upload
+        # hygiene + survivor-renormalized total) on the client-axis AND
+        # whole-mesh layouts (obd_scan_round_program's guard mode)
+        if type(self) is not SpmdFedOBDSession and not self._whole_mesh_fused:
             return (
                 f"{type(self).__name__} lays clients out as a"
                 " whole-mesh-per-client scan (own phase programs)"
             )
         return None
+
+    def _opt_carry_out_sharding(self):
+        """out_shardings pin for the per-slot opt-state carry.  The
+        whole-mesh layouts pin it REPLICATED: their donated carry enters
+        replicated, and an unpinned output can come back expert-sharded
+        from GSPMD propagation — a donation aliasing size mismatch at
+        runtime.  The client-axis layout leaves it to the compiler (the
+        carry is ``P("clients")``-sharded by the shard_map out_specs)."""
+        return self._replicated if self._whole_mesh_fused else None
 
     def _select_indices(self, round_number: int):
         """Gather-path selection, OBD flavor: ascending selected worker
@@ -494,13 +517,30 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 out_specs=(P(), P(), P("clients"), P()),
             )(global_params, opt_state_s, data, weights, rngs, bcast_rng)
 
+        return self._finish_obd_phase_fn(round_program, phase_two)
+
+    def _finish_obd_phase_fn(
+        self, round_program, phase_two: bool, out_shardings=None
+    ):
+        """The shared tail of every OBD ``_wrap_phase_program`` (the
+        client-axis shard_map layout AND the whole-mesh ep/sp scans):
+        register the un-jitted ``(global_params, opt_state_s, weights,
+        rngs, bcast_rng, data)`` program for the horizon builder, jit the
+        dense path, build + jit the gather twin when the selection gather
+        is active, and return the dispatch fn.  ``out_shardings`` pins
+        the jitted outputs to a stored layout (the expert-parallel
+        session's donated round-over-round buffers must never reshard)."""
         # the horizon builder scans this same program — one trace, shared
         # numerics with the per-round path
         self._phase_program_fns[phase_two] = round_program
+        jit_kwargs = (
+            {"out_shardings": out_shardings} if out_shardings is not None else {}
+        )
 
         gather_jitted = None
         if self._selection_gather:
             client_sharding = self._client_sharding
+            session = self
 
             def gather_phase_program(
                 global_params, opt_carry, weights, rngs, sel_idx, bcast_rng, data
@@ -510,13 +550,20 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 stays resident), with the per-slot optimizer states
                 gathered in (phase 2) / scattered back (both phases) so
                 the carried ``[n_slots]`` buffer matches the dense merge
-                bit-exactly."""
+                bit-exactly.  Data leaves are constrained back to their
+                OWN stored shardings (the client axis on client-axis
+                meshes; the sp layout keeps the sequence axis sharded
+                through the take)."""
 
-                def take(x):
+                def take(x, s=None):
                     return jax.lax.with_sharding_constraint(
-                        jnp.take(x, sel_idx, axis=0), client_sharding
+                        jnp.take(x, sel_idx, axis=0),
+                        client_sharding if s is None else s,
                     )
 
+                data_shardings = jax.tree.map(
+                    lambda x: x.sharding, session._data
+                )
                 opt_sel = jax.tree.map(take, opt_carry)
                 exact, bcast, opt_out, metrics = round_program(
                     global_params,
@@ -524,7 +571,7 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                     weights,
                     rngs,
                     bcast_rng,
-                    jax.tree.map(take, data),
+                    jax.tree.map(take, data, data_shardings),
                 )
                 # scatter-back: selected rows take their trained states,
                 # padding rows (weight 0, distinct unselected ids) write
@@ -541,7 +588,7 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
 
             self._gather_phase_program_fns[phase_two] = gather_phase_program
             gather_jitted = jax.jit(
-                gather_phase_program, donate_argnums=(0, 1)
+                gather_phase_program, donate_argnums=(0, 1), **jit_kwargs
             )
 
         # data as an argument, not a closure constant (see spmd.py); the
@@ -549,20 +596,22 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         # active selection) are donated alongside the params (same shape
         # in and out)
         donate = (0, 1) if (phase_two or self._phase1_carries_opt) else (0,)
-        jitted = jax.jit(round_program, donate_argnums=donate)
+        jitted = jax.jit(round_program, donate_argnums=donate, **jit_kwargs)
 
         def fn(
             global_params, weights, rngs, bcast_rng, opt_state_s=None,
             sel_idx=None,
         ):
-            if sel_idx is not None:
-                return gather_jitted(
-                    global_params, opt_state_s, weights, rngs, sel_idx,
-                    bcast_rng, self._data,
+            with self._round_mesh_context():
+                if sel_idx is not None:
+                    return gather_jitted(
+                        global_params, opt_state_s, weights, rngs, sel_idx,
+                        bcast_rng, self._data,
+                    )
+                return jitted(
+                    global_params, opt_state_s, weights, rngs, bcast_rng,
+                    self._data,
                 )
-            return jitted(
-                global_params, opt_state_s, weights, rngs, bcast_rng, self._data
-            )
 
         fn._jitted = jitted
         fn._jitted_gather = gather_jitted
@@ -633,13 +682,30 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             bcast, opt_state_s, exact, rng = carry
             return (exact, bcast, opt_state_s, rng), outs
 
-        jitted = jax.jit(horizon_program, donate_argnums=(0, 1, 2))
+        # the exact/broadcast carries keep the stored per-leaf layout so
+        # the donated round-over-round buffers never reshard between
+        # horizon chunks (a no-op on the replicated client-axis layout,
+        # load-bearing for the ep expert layout)
+        jitted = jax.jit(
+            horizon_program,
+            donate_argnums=(0, 1, 2),
+            out_shardings=(
+                (
+                    self._param_shardings,
+                    self._param_shardings,
+                    self._opt_carry_out_sharding(),
+                    None,
+                ),
+                None,
+            ),
+        )
 
         def fn(global_params, opt_state_s, rng, weight_rows, idx_rows=None):
-            return jitted(
-                global_params, opt_state_s, rng, weight_rows, idx_rows,
-                self._data, self._ensure_eval_batches(),
-            )
+            with self._round_mesh_context():
+                return jitted(
+                    global_params, opt_state_s, rng, weight_rows, idx_rows,
+                    self._data, self._ensure_eval_batches(),
+                )
 
         fn._jitted = jitted
         return fn
@@ -811,13 +877,15 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         os.makedirs(save_dir, exist_ok=True)
         driver = ObdRoundDriver.from_config(config)
         init_params, resumed_aggs, resumed_phase1 = self._try_resume_obd(driver)
-        # jnp.copy after placement: device_put of aligned host numpy (the
-        # npz resume path) ALIASES the python-owned buffer, and the round
-        # program donates these params — XLA must own the memory it reuses
-        # (see SpmdFedAvgSession._place_params)
-        train_params = jax.tree.map(
-            jnp.copy, put_sharded(init_params, self._replicated)
-        )
+        # _place_params = stored per-leaf layout + jnp.copy: the copy
+        # because device_put of aligned host numpy (the npz resume path)
+        # ALIASES the python-owned buffer and the phase programs DONATE
+        # these params; the per-leaf layout (replicated client-axis, the
+        # expert layout on ep) because the phase outputs are pinned to it
+        # — staging the first round replicated would leave the donated
+        # expert-sharded leaves unaliasable (two live copies of exactly
+        # the model-sharded kernels) and retrace on the second round
+        train_params = self._place_params(init_params)
         rng = jax.random.PRNGKey(config.seed)
         for _ in range(resumed_aggs):  # keep the rng stream aligned
             rng, _r, _b = jax.random.split(rng, 3)
@@ -848,12 +916,18 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             )
 
         def fresh_opt_states():
+            # pin the buffer to the session's slot layout (P("clients")
+            # client-axis, replicated whole-mesh): the phase programs
+            # DONATE this carry, and a compiler-chosen placement here
+            # would alias against the pinned carry output with mismatched
+            # per-device sizes
             return jax.jit(
                 jax.vmap(
                     self.engine.optimizer.init,
                     in_axes=None,
                     axis_size=self.n_slots,
-                )
+                ),
+                out_shardings=self._client_sharding,
             )(train_params)
 
         def step(fn, params, weights, round_number, phase_label, use_opt,

@@ -28,14 +28,31 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..engine.engine import ComputeEngine
 from .mesh import use_mesh
-from .spmd_obd import SpmdFedOBDSession
+from .spmd import guarded_average
+from .spmd_obd import SpmdFedOBDSession, _masked_slot_merge
 
 
-def obd_scan_round_program(local_train, qdq, phase_two: bool):
+def obd_scan_round_program(
+    local_train, qdq, phase_two: bool, guard_active: bool = False
+):
     """The whole-mesh-per-client FedOBD round: clients as a ``lax.scan``
     with on-device weighted accumulation and the quantized broadcast —
     shared by the expert-parallel (GSPMD jit) and sequence-parallel
-    (session shard_map) layouts."""
+    (session shard_map) layouts.
+
+    Parity with the client-axis shard_body (``spmd_obd.py``):
+
+    * under an ACTIVE selection the phase-1 carry (``opt_state_s`` not
+      None) is participation-MERGED after the scan — a slot's phase-2
+      seed is the state from its last participation, matching the
+      client-axis (and threaded) semantics on both dense and gather
+      paths;
+    * ``guard_active``: ``local_train`` already zeroed each rejected
+      client's contribution (the shared guard); here the total weight
+      becomes the sum of the guard's per-slot EFFECTIVE weights
+      (``_eff_weight`` accumulated through the metric sum) and a
+      zero-survivor round keeps the old global
+      (:func:`spmd.guarded_average`)."""
 
     def round_program(
         global_params, opt_state_s, weights, rngs, bcast_rng, data
@@ -76,12 +93,27 @@ def obd_scan_round_program(local_train, qdq, phase_two: bool):
         (local_sum, metrics), opt_out = jax.lax.scan(
             client_body, (zero_params, zero_metrics), xs
         )
-        total_weight = jnp.maximum(jnp.sum(weights), 1e-12)
-        new_global = jax.tree.map(
-            lambda s, g: (s / total_weight).astype(g.dtype),
-            local_sum,
-            global_params,
-        )
+        if not phase_two and opt_state_s is not None:
+            # selection-aware phase 1: the carried buffer keeps the
+            # unselected slots' states (their last participation); only
+            # selected slots take this round's trained states
+            opt_out = _masked_slot_merge(weights > 0, opt_out, opt_state_s)
+        if guard_active:
+            # survivor renormalization: the summed _eff_weight IS the
+            # total of the guard's effective weights (rejected slots at
+            # exactly zero); zero survivors keep the old global
+            metrics = dict(metrics)
+            total_weight = metrics.pop("_eff_weight")
+            new_global = guarded_average(
+                local_sum, total_weight, global_params
+            )
+        else:
+            total_weight = jnp.maximum(jnp.sum(weights), 1e-12)
+            new_global = jax.tree.map(
+                lambda s, g: (s / total_weight).astype(g.dtype),
+                local_sum,
+                global_params,
+            )
         bcast = {}
         bcast_bits = jnp.float32(0.0)
         for i, (k, v) in enumerate(new_global.items()):
@@ -97,6 +129,11 @@ def obd_scan_round_program(local_train, qdq, phase_two: bool):
 
 
 class SpmdFedOBDExpertParallelSession(SpmdFedOBDSession):
+    #: whole-mesh scan layout routed through the shared fused machinery
+    #: (spmd_obd.py::_finish_obd_phase_fn): selection gather,
+    #: round-horizon fusion and the update guard all apply
+    _whole_mesh_fused = True
+
     def __init__(
         self,
         config,
@@ -165,34 +202,33 @@ class SpmdFedOBDExpertParallelSession(SpmdFedOBDSession):
             return P("ep", None, None)
         return P()
 
+    def _round_mesh_context(self):
+        # bare-PartitionSpec constraints inside the MoE model resolve
+        # against the ambient mesh (version-compat helper: jax 0.4 has
+        # no jax.sharding.set_mesh)
+        return use_mesh(self.mesh)
+
     def _wrap_phase_program(self, local_train, qdq, phase_two: bool):
-        mesh = self.mesh
-        round_program = obd_scan_round_program(local_train, qdq, phase_two)
-        donate = (0, 1) if phase_two else (0,)
+        round_program = obd_scan_round_program(
+            local_train, qdq, phase_two, guard_active=self._update_guard
+        )
         # pin the aggregate AND broadcast to the stored expert layout so
-        # donated round-over-round buffers never reshard
-        jitted = jax.jit(
+        # donated round-over-round buffers never reshard; jit, gather
+        # twin, horizon registration and dispatch (all under use_mesh via
+        # _round_mesh_context) come from the shared machinery
+        return self._finish_obd_phase_fn(
             round_program,
-            donate_argnums=donate,
+            phase_two,
             out_shardings=(
                 self._param_shardings,
                 self._param_shardings,
-                None,
+                # the donated opt carry enters replicated — pin its output
+                # replicated too or GSPMD's expert-sharded choice trips a
+                # donation aliasing size mismatch at runtime
+                self._opt_carry_out_sharding(),
                 None,
             ),
         )
-
-        def fn(global_params, weights, rngs, bcast_rng, opt_state_s=None):
-            # bare-PartitionSpec constraints inside the MoE model resolve
-            # against the ambient mesh (version-compat helper: jax 0.4 has
-            # no jax.sharding.set_mesh)
-            with use_mesh(mesh):
-                return jitted(
-                    global_params, opt_state_s, weights, rngs, bcast_rng,
-                    self._data,
-                )
-
-        return fn
 
 
 def build_obd_expert_parallel_session(ctx, session_args, codec: str):

@@ -29,6 +29,11 @@ from .spmd_sp import SingleDeviceEvalMixin
 class SpmdFedOBDSequenceParallelSession(
     SingleDeviceEvalMixin, SpmdFedOBDSession
 ):
+    #: whole-mesh scan layout routed through the shared fused machinery
+    #: (spmd_obd.py::_finish_obd_phase_fn): selection gather,
+    #: round-horizon fusion and the update guard all apply
+    _whole_mesh_fused = True
+
     def __init__(
         self,
         config,
@@ -97,7 +102,9 @@ class SpmdFedOBDSequenceParallelSession(
 
     def _wrap_phase_program(self, local_train, qdq, phase_two: bool):
         mesh = self.mesh
-        scan_round = obd_scan_round_program(local_train, qdq, phase_two)
+        scan_round = obd_scan_round_program(
+            local_train, qdq, phase_two, guard_active=self._update_guard
+        )
 
         def round_program(
             global_params, opt_state_s, weights, rngs, bcast_rng, data
@@ -124,16 +131,9 @@ class SpmdFedOBDSequenceParallelSession(
                 out_specs=(P(), P(), P(), P()),
             )(global_params, data, weights, rngs, bcast_rng, opt_state_s)
 
-        donate = (0, 1) if phase_two else (0,)
-        jitted = jax.jit(round_program, donate_argnums=donate)
-
-        def fn(global_params, weights, rngs, bcast_rng, opt_state_s=None):
-            return jitted(
-                global_params, opt_state_s, weights, rngs, bcast_rng,
-                self._data,
-            )
-
-        return fn
+        # jit, gather twin, horizon registration and dispatch come from
+        # the shared machinery (spmd_obd.py::_finish_obd_phase_fn)
+        return self._finish_obd_phase_fn(round_program, phase_two)
 
 
 def build_obd_sequence_parallel_session(ctx, session_args, codec: str):

@@ -49,6 +49,11 @@ from .spmd_sp import SingleDeviceEvalMixin
 
 
 class SpmdPipelineSession(SingleDeviceEvalMixin, SpmdFedAvgSession):
+    #: whole-mesh layout routed through the shared fused-round machinery:
+    #: selection gather, round-horizon fusion and the update guard all
+    #: apply (spmd.py::_wrap_round_programs)
+    _whole_mesh_fused = True
+
     def __init__(
         self,
         config,
@@ -121,6 +126,20 @@ class SpmdPipelineSession(SingleDeviceEvalMixin, SpmdFedAvgSession):
             return P("pp")
         return P()
 
+    def _update_guard_unsupported_reason(self) -> str | None:
+        # inside the session shard_map the trunk params are per-STAGE
+        # local slices: a client's delta norm/finiteness check would be
+        # stage-local and could disagree across devices (divergent
+        # effective weights -> divergent aggregates).  The ep/sp layouts
+        # see full deltas (GSPMD global ops / replicated params) and
+        # support the guard; pipeline keeps the loud rejection until the
+        # guard grows a cross-stage reduction.
+        return (
+            "the pipeline session's trunk params are per-stage local"
+            " slices inside shard_map — the per-client delta hygiene"
+            " check cannot be evaluated consistently across stages"
+        )
+
     def _build_round_fn(self):
         engine = self._pp_engine
         epochs = self.config.epoch
@@ -145,14 +164,10 @@ class SpmdPipelineSession(SingleDeviceEvalMixin, SpmdFedAvgSession):
                 out_specs=(param_specs, P()),
             )(global_params, data, val, weights, rngs)
 
-        jitted = jax.jit(round_program, donate_argnums=(0,))
-
-        def fn(global_params, weights, rngs):
-            return jitted(
-                global_params, weights, rngs, self._data, self._val_data or {}
-            )
-
-        return fn
+        # gather twin + horizon fusion + dispatch come from the shared
+        # machinery; the trunk's stored P("pp") layout rides the horizon
+        # carry's out_shardings pin
+        return self._wrap_round_programs(round_program)
 
 
 def build_pipeline_session(ctx, session_args, session_kwargs):

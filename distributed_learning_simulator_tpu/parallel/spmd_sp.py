@@ -50,6 +50,13 @@ class SingleDeviceEvalMixin:
     (``DLS_TPU_FUSED_ATTN=interpret``: an ``io_callback`` cannot live
     inside a partitioned program)."""
 
+    #: the single-device eval batches live on their OWN attribute — the
+    #: fused-horizon path builds mesh-replicated ``_eval_batches`` for its
+    #: in-program eval (``_ensure_eval_batches``), and a fused run that
+    #: drops to a per-round tail must not hand those mesh-placed arrays
+    #: to this single-device jit
+    _host_eval_batches = None
+
     def _evaluate(self, global_params) -> dict:
         if jax.process_count() > 1:
             # a multi-host pod cannot device_put to one global device
@@ -60,25 +67,30 @@ class SingleDeviceEvalMixin:
         from ..ml_type import MachineLearningPhase as Phase
 
         device = self.mesh.devices.flat[0]
-        if self._eval_batches is None:
+        if self._host_eval_batches is None:
             from ..engine.batching import make_epoch_batches
 
             test = self.dc.get_dataset(Phase.Test)
-            self._eval_batches = jax.device_put(
+            self._host_eval_batches = jax.device_put(
                 make_epoch_batches(test, self.config.batch_size), device
             )
         params = jax.device_put(global_params, device)
-        summed = self.engine.evaluate(params, self._eval_batches)
+        summed = self.engine.evaluate(params, self._host_eval_batches)
         metric = summarize_metrics(summed)
         metric.update(
             maybe_slow_metrics(
-                self.config, self.engine, params, self._eval_batches
+                self.config, self.engine, params, self._host_eval_batches
             )
         )
         return metric
 
 
 class SpmdSequenceParallelSession(SingleDeviceEvalMixin, SpmdFedAvgSession):
+    #: whole-mesh layout routed through the shared fused-round machinery:
+    #: selection gather, round-horizon fusion and the update guard all
+    #: apply (spmd.py::_wrap_round_programs)
+    _whole_mesh_fused = True
+
     def __init__(
         self,
         config,
@@ -148,6 +160,8 @@ class SpmdSequenceParallelSession(SingleDeviceEvalMixin, SpmdFedAvgSession):
         engine = self._sp_engine
         epochs = self.config.epoch
         mesh = self.mesh
+        guard_active = self._update_guard
+        max_update_norm = self._max_update_norm
         _, metrics_shape = whole_mesh_session_shapes(self)
 
         def round_program(global_params, weights, rngs, data, val):
@@ -157,6 +171,8 @@ class SpmdSequenceParallelSession(SingleDeviceEvalMixin, SpmdFedAvgSession):
                 return scan_weighted_clients(
                     engine, epochs, global_params, data, weights, rngs,
                     metrics_shape, val_data=val if val else None,
+                    guard_active=guard_active,
+                    max_update_norm=max_update_norm,
                 )
 
             def seq_specs(tree):
@@ -174,14 +190,10 @@ class SpmdSequenceParallelSession(SingleDeviceEvalMixin, SpmdFedAvgSession):
                 out_specs=(P(), P()),
             )(global_params, data, val, weights, rngs)
 
-        jitted = jax.jit(round_program, donate_argnums=(0,))
-
-        def fn(global_params, weights, rngs):
-            return jitted(
-                global_params, weights, rngs, self._data, self._val_data or {}
-            )
-
-        return fn
+        # gather twin + horizon fusion + dispatch come from the shared
+        # machinery; the gather's per-leaf sharding-preserving take keeps
+        # the sequence axis sharded through the slot gather
+        return self._wrap_round_programs(round_program)
 
 
 def build_sequence_parallel_session(ctx, session_args, session_kwargs):

@@ -33,6 +33,31 @@ for exec_mode in sequential spmd; do
     ++fed_avg.algorithm_kwargs.min_client_quorum=1
 done
 
+# whole-mesh fault smoke (PR 8): the expert-parallel FedOBD layout now
+# supports the in-program update guard + quorum — a seeded FaultPlan
+# drops clients and corrupts one upload on the whole-mesh-per-client
+# scan, and the run must reject the poison and finish.  expert_parallel=1
+# keeps the smoke runnable on a single-device CPU host (the layout and
+# guard code paths are identical at any ep size); the model is shrunk to
+# keep the XLA:CPU compile time bounded.
+run --config-name large_scale/fed_obd/moe_imdb_ep.yaml \
+  ++fed_obd.round=2 ++fed_obd.epoch=1 ++fed_obd.worker_number=4 \
+  ++fed_obd.algorithm_kwargs.random_client_number=3 \
+  ++fed_obd.algorithm_kwargs.second_phase_epoch=1 \
+  ++fed_obd.algorithm_kwargs.round_horizon=2 \
+  ++fed_obd.algorithm_kwargs.min_client_quorum=1 \
+  ++fed_obd.model_kwargs.expert_parallel=1 \
+  ++fed_obd.model_kwargs.d_model=32 ++fed_obd.model_kwargs.nhead=2 \
+  ++fed_obd.model_kwargs.num_encoder_layer=2 \
+  ++fed_obd.model_kwargs.n_experts=2 ++fed_obd.model_kwargs.max_len=64 \
+  ++fed_obd.dataset_kwargs.max_len=64 \
+  ++fed_obd.dataset_kwargs.train_size=64 ++fed_obd.dataset_kwargs.test_size=32 \
+  ++fed_obd.use_amp=False \
+  ++fed_obd.fault_tolerance.seed=1 \
+  ++fed_obd.fault_tolerance.dropout_rate=0.3 \
+  ++fed_obd.fault_tolerance.corrupt_schedule.2='[0]' \
+  ++fed_obd.fault_tolerance.update_guard=True
+
 run --config-name fed_gnn/cs.yaml \
   ++fed_gnn.round=1 ++fed_gnn.epoch=1 ++fed_gnn.worker_number=2
 

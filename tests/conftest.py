@@ -40,6 +40,90 @@ def tmp_session_dir(tmp_path, monkeypatch):
     return tmp_path
 
 
+#: canonical tiny expert-parallel MoE shape (the test_obd_sharding_axes
+#: scale) shared by the round-horizon / selection-gather / fault suites
+MOE_EP_MODEL_KWARGS = dict(
+    d_model=16,
+    nhead=2,
+    num_encoder_layer=2,
+    n_experts=4,
+    max_len=16,
+    expert_parallel=4,
+)
+
+#: canonical tiny sequence-parallel long-context shape (same provenance)
+LONGCONTEXT_SP_MODEL_KWARGS = dict(
+    d_model=32,
+    nhead=4,
+    num_encoder_layer=1,
+    max_len=64,
+    dropout_rate=0.0,
+    sequence_parallel=4,
+)
+
+
+def whole_mesh_config(
+    save_dir,
+    model_name="MoETransformerClassificationModel",
+    dataset_max_len=16,
+    algorithm="fed_obd",
+    workers=2,
+    rounds=2,
+    algorithm_kwargs=None,
+    fault_tolerance=None,
+    model_kwargs=None,
+):
+    """Tiny imdb config factory for the whole-mesh (ep/sp) session pins —
+    ONE source of truth for the canonical tiny ep/sp shapes the
+    round-horizon, selection-gather and fault suites share (small enough
+    for the tier-1 budget).  ``model_kwargs`` defaults to the ep MoE
+    shape; pass ``LONGCONTEXT_SP_MODEL_KWARGS`` (with
+    ``dataset_max_len=64``) for the sp layout."""
+    from distributed_learning_simulator_tpu.config import (
+        DistributedTrainingConfig,
+    )
+
+    kwargs = dict(algorithm_kwargs or {})
+    endpoint_kwargs = {}
+    if algorithm.startswith("fed_obd"):
+        kwargs.setdefault("dropout_rate", 0.3)
+        kwargs.setdefault("second_phase_epoch", 1)
+        endpoint_kwargs = {
+            "server": {"weight": 0.01},
+            "worker": {"weight": 0.01},
+        }
+    config = DistributedTrainingConfig(
+        dataset_name="imdb",
+        model_name=model_name,
+        distributed_algorithm=algorithm,
+        executor="spmd",
+        worker_number=workers,
+        batch_size=4,
+        round=rounds,
+        epoch=1,
+        learning_rate=0.05,
+        algorithm_kwargs=kwargs,
+        endpoint_kwargs=endpoint_kwargs,
+        dataset_kwargs={
+            "train_size": 8 * workers,
+            "val_size": 4,
+            "test_size": 8,
+            "max_len": dataset_max_len,
+        },
+        # `is not None`, not `or`: an explicit {} means "the model's own
+        # defaults", not the MoE shape — falling through would build a
+        # non-MoE model with bogus expert kwargs
+        model_kwargs=dict(
+            model_kwargs if model_kwargs is not None else MOE_EP_MODEL_KWARGS
+        ),
+        save_dir=save_dir,
+    )
+    if fault_tolerance is not None:
+        config.fault_tolerance = fault_tolerance
+    config.load_config_and_process()
+    return config
+
+
 def fed_avg_config(**overrides):
     """Shared tiny MNIST/LeNet5 fed_avg config factory (one definition for
     the e2e/resume/fault suites; override what the test cares about)."""

@@ -93,6 +93,30 @@ def test_bench_main_prints_one_json_line(monkeypatch):
             "wasted_compute_fraction": 0.0,
         },
     )
+    monkeypatch.setattr(
+        bench,
+        "measure_ep_fusion",
+        lambda: {
+            "model": "MoETransformer/imdb",
+            "horizon": bench.EP_HORIZON,
+            "expert_parallel": 4,
+            "dense_h1": {
+                "rounds_per_sec": 0.1,
+                "dispatches_per_round": 2.0,
+                "host_sync_points": 1.0,
+                "selection_path": "dense",
+                "wasted_compute_fraction": 0.5,
+            },
+            f"gather_h{bench.EP_HORIZON}": {
+                "rounds_per_sec": 0.3,
+                "dispatches_per_round": 1.0 / bench.EP_HORIZON,
+                "host_sync_points": 1.0 / bench.EP_HORIZON,
+                "selection_path": "gather",
+                "wasted_compute_fraction": 0.0,
+            },
+            "speedup": 3.0,
+        },
+    )
     monkeypatch.setattr(bench, "measure_lint", lambda: 38)
     monkeypatch.setattr(
         bench,
@@ -131,6 +155,8 @@ def test_bench_main_prints_one_json_line(monkeypatch):
         "selection",
         "obd_fusion_path",
         "obd_fusion",
+        "ep_fusion_path",
+        "ep_fusion",
         "dropout_overhead_fraction",
         "fault_tolerance",
         "lint_findings",
@@ -160,6 +186,15 @@ def test_bench_main_prints_one_json_line(monkeypatch):
     assert obd["dispatches_per_round"] < 1.0
     assert obd["speedup"] == 2.5
     assert "dense_h1" in payload["obd_fusion"]
+    # whole-mesh fusion: the ep FedOBD session's fused arm certifies the
+    # same budget on the whole-mesh-per-client scan layout
+    ep = payload["ep_fusion_path"]
+    assert ep["selection_path"] == "gather"
+    assert ep["dispatches_per_round"] == 1.0 / bench.EP_HORIZON
+    assert ep["dispatches_per_round"] < 1.0
+    assert ep["host_sync_points"] <= 1.0
+    assert ep["speedup"] == 3.0
+    assert "dense_h1" in payload["ep_fusion"]
     # fault tolerance: the masked-vs-unmasked dropout A/B (top-level
     # fraction mirrors the measurement's own field)
     assert payload["dropout_overhead_fraction"] == 0.02
@@ -185,6 +220,7 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     monkeypatch.setattr(bench, "measure_aggregation", boom)
     monkeypatch.setattr(bench, "measure_round_horizon", boom)
     monkeypatch.setattr(bench, "measure_obd_horizon", boom)
+    monkeypatch.setattr(bench, "measure_ep_fusion", boom)
     monkeypatch.setattr(bench, "measure_selection_gather", boom)
     monkeypatch.setattr(bench, "measure_fault_tolerance", boom)
     monkeypatch.setattr(bench, "measure_lint", boom)
@@ -212,6 +248,11 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     assert "error" in payload["obd_fusion"]
     assert payload["obd_fusion_path"]["selection_path"] == "gather"
     assert payload["obd_fusion_path"]["dispatches_per_round"] == 0.0
+    # ep fusion degrades the same way (-1/absent-never: fields always
+    # present, error marker + 0.0 defaults)
+    assert "error" in payload["ep_fusion"]
+    assert payload["ep_fusion_path"]["selection_path"] == "gather"
+    assert payload["ep_fusion_path"]["dispatches_per_round"] == 0.0
     # fault-tolerance A/B degrades to an error marker; the top-level
     # fraction degrades to -1 (the -1/absent-never contract)
     assert "error" in payload["fault_tolerance"]

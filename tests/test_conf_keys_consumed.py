@@ -48,6 +48,9 @@ CONSUMERS: dict[tuple[str, str], list[str]] = {
         "utils/selection.py",
     ],
     ("algorithm_kwargs", "second_phase_epoch"): ["method/fed_obd/driver.py"],
+    ("algorithm_kwargs", "sv_batch_chunk"): [
+        "method/shapley_value/shapley_value_algorithm.py",
+    ],
     ("algorithm_kwargs", "round_horizon"): [
         "parallel/spmd.py",
         "parallel/spmd_obd.py",

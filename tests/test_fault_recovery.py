@@ -374,6 +374,143 @@ def test_dropout_parity_fed_obd(tmp_session_dir):
 
 
 # ---------------------------------------------------------------------------
+# whole-mesh fault-model parity (PR 8): the ep/sp layouts get the same
+# in-program dropout masking and the compiled update guard the client-axis
+# sessions have — the old "ep/sp reject update_guard loudly" carve-out is
+# gone.
+# ---------------------------------------------------------------------------
+
+
+def _ep_config(save_dir, algorithm="fed_avg", workers=2, rounds=3,
+               algorithm_kwargs=None, fault_tolerance=None):
+    """Tiny expert-parallel imdb/MoE config — the shared whole-mesh
+    factory at the fault suite's defaults."""
+    from conftest import whole_mesh_config
+
+    return whole_mesh_config(
+        save_dir,
+        algorithm=algorithm,
+        workers=workers,
+        rounds=rounds,
+        algorithm_kwargs=algorithm_kwargs,
+        fault_tolerance=fault_tolerance,
+    )
+
+
+def test_empty_fault_config_bit_exact_expert_parallel(tmp_session_dir):
+    """The zero-overhead contract on the whole-mesh layout: an empty
+    ``fault_tolerance`` leaves the ep round programs and trajectories
+    untouched — params and metrics bit-identical."""
+    base = train(_ep_config("ep_base"))
+    empty = train(_ep_config("ep_empty", fault_tolerance={}))
+    _assert_same_metrics(base, empty)
+    pa, pb = _final_params("ep_base", 3), _final_params("ep_empty", 3)
+    for key in pa:
+        np.testing.assert_array_equal(pa[key], pb[key], err_msg=key)
+
+
+def test_dropout_parity_gather_vs_dense_expert_parallel(tmp_session_dir):
+    """The availability mask rides the whole-mesh weight rows exactly as
+    on the client axis: dropped ids are zero-masked out of the dense scan
+    and masked out of the gathered S_pad rows — identical metrics and
+    bit-identical params under the same injected schedule."""
+    def cfg(save_dir, gather):
+        return _ep_config(
+            save_dir,
+            workers=4,
+            algorithm_kwargs={
+                "random_client_number": 3,
+                "selection_gather": gather,
+            },
+            fault_tolerance={"dropout_schedule": {2: [0, 2]}},
+        )
+
+    dense = train(cfg("epd_d", False))
+    gathered = train(cfg("epd_g", True))
+    _assert_same_metrics(dense, gathered)
+    pa, pb = _final_params("epd_d", 3), _final_params("epd_g", 3)
+    for key in pa:
+        np.testing.assert_array_equal(pa[key], pb[key], err_msg=key)
+
+
+def test_guard_rejection_parity_expert_parallel(tmp_session_dir):
+    """The update guard compiles into the whole-mesh client scan: a
+    corrupt (NaN-weight) upload is rejected in-program with the round
+    renormalized over survivors, the record row counts the rejection, and
+    the fused H=2 run reproduces the per-round trajectory bit-exactly
+    (the guard rides the fused scan body unchanged)."""
+    def cfg(save_dir, horizon=1):
+        kwargs = {}
+        if horizon != 1:
+            kwargs["round_horizon"] = horizon
+        return _ep_config(
+            save_dir,
+            workers=4,
+            rounds=4,
+            algorithm_kwargs=kwargs,
+            fault_tolerance={
+                "corrupt_schedule": {2: [1]},
+                "update_guard": True,
+            },
+        )
+
+    h1 = train(cfg("epg_h1"))
+    stat = h1["performance"]
+    assert stat[1]["rejected_updates"] == 0
+    assert stat[2]["rejected_updates"] == 1
+    assert all(np.isfinite(stat[r]["test_loss"]) for r in stat)
+    h2 = train(cfg("epg_h2", horizon=2))
+    _assert_same_metrics(h1, h2)
+    assert h2["performance"][2]["rejected_updates"] == 1
+    pa, pb = _final_params("epg_h1", 4), _final_params("epg_h2", 4)
+    for key in pa:
+        np.testing.assert_array_equal(pa[key], pb[key], err_msg=key)
+
+
+@pytest.mark.slow
+def test_fault_parity_sequence_parallel(tmp_session_dir):
+    """The sequence-parallel FedOBD layout: empty fault config bit-exact,
+    and gather-vs-dense parity under an injected dropout schedule (the
+    mask rides the same weight rows; the opt-state merge treats a dropout
+    as a missed participation on the whole-mesh scan too)."""
+    from conftest import LONGCONTEXT_SP_MODEL_KWARGS, whole_mesh_config
+
+    def sp_config(save_dir, gather=None, fault_tolerance=None):
+        kwargs = {}
+        if gather is not None:
+            kwargs = {"random_client_number": 3, "selection_gather": gather}
+        return whole_mesh_config(
+            save_dir,
+            model_name="LongContextTransformer",
+            dataset_max_len=64,
+            workers=4,
+            algorithm_kwargs=kwargs,
+            fault_tolerance=fault_tolerance,
+            model_kwargs=LONGCONTEXT_SP_MODEL_KWARGS,
+        )
+
+    base = train(sp_config("sp_base"))
+    empty = train(sp_config("sp_empty", fault_tolerance={}))
+    _assert_same_metrics(base, empty)
+    dense = train(
+        sp_config(
+            "sp_fd", gather=False,
+            fault_tolerance={"dropout_schedule": {2: [0, 2]}},
+        )
+    )
+    gathered = train(
+        sp_config(
+            "sp_fg", gather=True,
+            fault_tolerance={"dropout_schedule": {2: [0, 2]}},
+        )
+    )
+    _assert_same_metrics(dense, gathered)
+    pa, pb = _final_params("sp_fd", 3), _final_params("sp_fg", 3)
+    for key in pa:
+        np.testing.assert_array_equal(pa[key], pb[key], err_msg=key)
+
+
+# ---------------------------------------------------------------------------
 # quorum + update hygiene
 # ---------------------------------------------------------------------------
 

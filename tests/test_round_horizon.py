@@ -9,6 +9,7 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from conftest import fed_avg_config
 from distributed_learning_simulator_tpu.training import _build_task, train
@@ -363,50 +364,202 @@ def test_obd_resume_from_horizon_boundary_rejoins_h1_chain(tmp_session_dir):
         np.testing.assert_array_equal(pa[key], pb[key], err_msg=key)
 
 
-def test_obd_expert_parallel_rejects_round_horizon(tmp_session_dir):
-    """The expert-parallel FedOBD subclass keeps its own per-round phase
-    programs — round_horizon must be refused loudly, not silently ignored
-    (the client-axis session now fuses instead of rejecting)."""
-    import pytest
+# ---------------------------------------------------------------------------
+# Whole-mesh fused rounds (PR 8): the ep/sp whole-mesh-per-client layouts
+# run the same round-horizon fusion the client-axis family does — H>1 must
+# be a pure scheduling change on them too (the old loud rejections are
+# gone; the capability rides spmd.py::_whole_mesh_fused).
 
+
+def _whole_mesh_config(save_dir, model_name, dataset_max_len, horizon=1,
+                       algorithm="fed_obd", rounds=2, **model_extra):
+    """Thin wrapper over the shared tiny whole-mesh factory
+    (conftest.whole_mesh_config) adding the horizon knob."""
+    from conftest import whole_mesh_config
+
+    algorithm_kwargs = {}
+    if horizon != 1:
+        algorithm_kwargs["round_horizon"] = horizon
+    return whole_mesh_config(
+        save_dir,
+        model_name=model_name,
+        dataset_max_len=dataset_max_len,
+        algorithm=algorithm,
+        rounds=rounds,
+        algorithm_kwargs=algorithm_kwargs,
+        model_kwargs=model_extra,
+    )
+
+
+def _moe_kwargs(**extra):
+    from conftest import MOE_EP_MODEL_KWARGS
+
+    kwargs = dict(MOE_EP_MODEL_KWARGS)
+    kwargs.pop("expert_parallel")
+    return dict(kwargs, **extra)
+
+
+def test_expert_parallel_h1_vs_h4_bit_exact(tmp_session_dir):
+    """The fed_avg expert-parallel session fuses rounds: H=4 runs the 4
+    rounds in ONE dispatch (whole-mesh clients scanned inside the fused
+    scan, GSPMD expert sharding intact) and must reproduce the H=1
+    per-round trajectory bit-exactly — and the session's dispatch budget
+    drops below one dispatch/sync per round."""
+    from distributed_learning_simulator_tpu.parallel.spmd_ep import (
+        SpmdExpertParallelSession,
+    )
+
+    r1 = train(
+        _whole_mesh_config(
+            "ep_h1", "MoETransformerClassificationModel", 16,
+            algorithm="fed_avg", rounds=4, **_moe_kwargs(expert_parallel=4),
+        )
+    )
+    config = _whole_mesh_config(
+        "ep_h4", "MoETransformerClassificationModel", 16,
+        algorithm="fed_avg", rounds=4, horizon=4,
+        **_moe_kwargs(expert_parallel=4),
+    )
+    ctx = _build_task(config)
+    session = SpmdExpertParallelSession(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+        expert_parallel=4,
+    )
+    r4 = session.run()
+    assert set(r1["performance"]) == set(r4["performance"]) == set(range(1, 5))
+    for rn in range(1, 5):
+        a, b = r1["performance"][rn], r4["performance"][rn]
+        assert a["test_accuracy"] == b["test_accuracy"], (rn, a, b)
+        assert a["test_loss"] == b["test_loss"], (rn, a, b)
+    p1 = _final_params("ep_h1", 4)
+    p4 = _final_params("ep_h4", 4)
+    assert p1.keys() == p4.keys()
+    for key in p1:
+        np.testing.assert_array_equal(p1[key], p4[key], err_msg=key)
+    # 4 rounds in ONE fused dispatch + ONE stacked-metric host sync,
+    # through one compiled horizon program
+    assert session.rounds_run == 4
+    assert session.dispatch_count == 1
+    assert session.host_sync_count == 1
+    assert session.dispatches_per_round <= 1 / 4 + 1e-9
+    assert session._horizon_fns[4]._jitted._cache_size() == 1
+
+
+def test_obd_expert_parallel_h1_vs_h2_bit_exact_across_phase_boundary(
+    tmp_session_dir,
+):
+    """The expert-parallel FedOBD session fuses same-phase rounds exactly
+    like the client-axis one: H=2 fuses the 2 phase-1 rounds into one
+    dispatch, clamps at the phase boundary, and the whole two-phase
+    trajectory (metrics, wire accounting, phase tags, final exact
+    aggregate) equals the per-round loop bit-exactly."""
+    r1 = train(
+        _whole_mesh_config(
+            "oep_h1", "MoETransformerClassificationModel", 16,
+            **_moe_kwargs(expert_parallel=4),
+        )
+    )
+    r2 = train(
+        _whole_mesh_config(
+            "oep_h2", "MoETransformerClassificationModel", 16, horizon=2,
+            **_moe_kwargs(expert_parallel=4),
+        )
+    )
+    assert _obd_rows(r1) == _obd_rows(r2)
+    p1 = _final_params("oep_h1", 3)
+    p2 = _final_params("oep_h2", 3)
+    assert p1.keys() == p2.keys()
+    for key in p1:
+        np.testing.assert_array_equal(p1[key], p2[key], err_msg=key)
+
+
+@pytest.mark.slow
+def test_pipeline_session_fused_gather_matches_dense_per_round(
+    tmp_session_dir,
+):
+    """The pipeline session (GPipe trunk over a ("pp",) mesh) composes
+    BOTH machineries: dense/H=1 vs gather/H=2 under an active selection
+    must be bit-exact — the fused scan carries the P("pp")-sharded trunk
+    and the gather scans only the selected cohort."""
     from distributed_learning_simulator_tpu.config import (
         DistributedTrainingConfig,
     )
 
-    config = DistributedTrainingConfig(
-        dataset_name="imdb",
-        model_name="MoETransformerClassificationModel",
-        distributed_algorithm="fed_obd",
-        executor="spmd",
-        worker_number=2,
-        batch_size=4,
-        round=2,
-        epoch=1,
-        learning_rate=0.05,
-        algorithm_kwargs={
-            "dropout_rate": 0.3,
-            "second_phase_epoch": 1,
-            "round_horizon": 2,
-        },
-        endpoint_kwargs={
-            "server": {"weight": 0.01},
-            "worker": {"weight": 0.01},
-        },
-        dataset_kwargs={
-            "train_size": 16,
-            "val_size": 4,
-            "test_size": 8,
-            "max_len": 16,
-        },
-        model_kwargs={
-            "d_model": 16,
-            "nhead": 2,
-            "num_encoder_layer": 2,
-            "n_experts": 4,
-            "max_len": 16,
-            "expert_parallel": 4,
-        },
+    def pp_config(save_dir, gather, horizon):
+        algorithm_kwargs = {
+            "random_client_number": 2,
+            "selection_gather": gather,
+        }
+        if horizon != 1:
+            algorithm_kwargs["round_horizon"] = horizon
+        config = DistributedTrainingConfig(
+            dataset_name="imdb",
+            model_name="TransformerClassificationModel",
+            distributed_algorithm="fed_avg",
+            executor="auto",
+            worker_number=4,
+            batch_size=8,
+            round=2,
+            epoch=1,
+            learning_rate=0.05,
+            algorithm_kwargs=algorithm_kwargs,
+            dataset_kwargs={
+                "train_size": 32,
+                "val_size": 4,
+                "test_size": 8,
+                "max_len": 32,
+            },
+            model_kwargs={
+                "d_model": 32,
+                "nhead": 4,
+                "num_encoder_layer": 4,
+                "max_len": 32,
+                "pipeline_stages": 2,
+                "pipeline_microbatches": 2,
+            },
+            save_dir=save_dir,
+        )
+        config.load_config_and_process()
+        return config
+
+    dense = train(pp_config("pp_d", gather=False, horizon=1))
+    fused = train(pp_config("pp_f", gather=True, horizon=2))
+    assert set(dense["performance"]) == set(fused["performance"])
+    for rn in sorted(dense["performance"]):
+        a, b = dense["performance"][rn], fused["performance"][rn]
+        assert a["test_accuracy"] == b["test_accuracy"], (rn, a, b)
+        assert a["test_loss"] == b["test_loss"], (rn, a, b)
+    pa = _final_params("pp_d", 2)
+    pb = _final_params("pp_f", 2)
+    for key in pa:
+        np.testing.assert_array_equal(pa[key], pb[key], err_msg=key)
+
+
+@pytest.mark.slow
+def test_obd_sequence_parallel_h1_vs_h2_bit_exact_across_phase_boundary(
+    tmp_session_dir,
+):
+    """The sequence-parallel FedOBD session (ring attention under the
+    session shard_map) fuses the same way — H=2 vs H=1 bit-exact through
+    the phase-2 switch.  (slow: the sp e2e pairs are the heaviest tiny
+    configs — same policy as the sequence_parallel_config suite.)"""
+    from conftest import LONGCONTEXT_SP_MODEL_KWARGS
+
+    sp_kwargs = dict(LONGCONTEXT_SP_MODEL_KWARGS)
+    r1 = train(
+        _whole_mesh_config("osp_h1", "LongContextTransformer", 64, **sp_kwargs)
     )
-    config.load_config_and_process()
-    with pytest.raises(ValueError, match="round_horizon"):
-        train(config)
+    r2 = train(
+        _whole_mesh_config(
+            "osp_h2", "LongContextTransformer", 64, horizon=2, **sp_kwargs
+        )
+    )
+    assert _obd_rows(r1) == _obd_rows(r2)
+    p1 = _final_params("osp_h1", 3)
+    p2 = _final_params("osp_h2", 3)
+    for key in p1:
+        np.testing.assert_array_equal(p1[key], p2[key], err_msg=key)

@@ -311,76 +311,136 @@ def test_obd_phase2_gather_program_parity(tmp_session_dir):
         np.testing.assert_array_equal(g_leaf[unselected], o_leaf[unselected])
 
 
-def test_obd_expert_parallel_gather_falls_back_loudly(tmp_session_dir):
-    """The expert-parallel FedOBD subclass lays clients out as a
-    whole-mesh scan — requesting the gather must warn and run dense."""
-    from distributed_learning_simulator_tpu.config import (
-        DistributedTrainingConfig,
-    )
+# ---------------------------------------------------------------------------
+# Whole-mesh selection-aware cohorts (PR 8): the ep/sp layouts scan only
+# the S_pad selected entries under random_client_number — the old loud
+# dense fallback is gone; S_pad on a whole-mesh (no client axes) mesh is
+# the selected count exactly.
 
-    config = DistributedTrainingConfig(
-        dataset_name="imdb",
-        model_name="MoETransformerClassificationModel",
-        distributed_algorithm="fed_obd",
-        executor="spmd",
-        worker_number=4,
-        batch_size=4,
-        round=2,
-        epoch=1,
-        learning_rate=0.05,
+
+def _whole_mesh_config(save_dir, model_name, dataset_max_len, gather,
+                       algorithm="fed_obd", workers=4, k=2, rounds=2,
+                       **model_extra):
+    """Thin wrapper over the shared tiny whole-mesh factory
+    (conftest.whole_mesh_config) adding the selection knobs."""
+    from conftest import whole_mesh_config
+
+    return whole_mesh_config(
+        save_dir,
+        model_name=model_name,
+        dataset_max_len=dataset_max_len,
+        algorithm=algorithm,
+        workers=workers,
+        rounds=rounds,
         algorithm_kwargs={
-            "dropout_rate": 0.3,
-            "second_phase_epoch": 1,
-            "random_client_number": 2,
-            "selection_gather": True,
+            "random_client_number": k,
+            "selection_gather": gather,
         },
-        endpoint_kwargs={
-            "server": {"weight": 0.01},
-            "worker": {"weight": 0.01},
-        },
-        dataset_kwargs={
-            "train_size": 16,
-            "val_size": 4,
-            "test_size": 8,
-            "max_len": 16,
-        },
-        model_kwargs={
-            "d_model": 16,
-            "nhead": 2,
-            "num_encoder_layer": 2,
-            "n_experts": 4,
-            "max_len": 16,
-            "expert_parallel": 4,
-        },
-    )
-    config.load_config_and_process()
-    ctx = _build_task(config)
-    from distributed_learning_simulator_tpu.engine.engine import ComputeEngine
-    from distributed_learning_simulator_tpu.parallel.spmd_obd_ep import (
-        SpmdFedOBDExpertParallelSession,
+        model_kwargs=model_extra,
     )
 
-    records = []
-    handler = logging.Handler()
-    handler.emit = lambda r: records.append(r.getMessage())
-    logger = get_logger()
-    logger.addHandler(handler)
-    try:
-        session = SpmdFedOBDExpertParallelSession(
-            ctx.config,
-            ctx.dataset_collection,
-            ctx.model_ctx,
-            ctx.engine,
-            ctx.practitioners,
-            expert_parallel=4,
-        )
-    finally:
-        logger.removeHandler(handler)
-    assert not session._selection_gather
-    assert session.s_pad == session.n_slots
-    assert any(
-        "selection_gather" in m and "dense" in m for m in records
+
+from conftest import MOE_EP_MODEL_KWARGS as _MOE_KWARGS  # noqa: E402
+
+
+def test_expert_parallel_gather_vs_dense_bit_exact(tmp_session_dir):
+    """fed_avg on the expert-parallel layout: the gather path scans only
+    the s_pad = selected cohort (no padding — a whole-mesh mesh has no
+    client axes to pad to) and must reproduce the dense O(population)
+    scan bit-exactly; rng streams are fold_in-indexed by worker id, which
+    the gathered id rows carry."""
+    from distributed_learning_simulator_tpu.parallel.spmd_ep import (
+        SpmdExpertParallelSession,
     )
+
+    dense = train(
+        _whole_mesh_config(
+            "ep_d", "MoETransformerClassificationModel", 16, gather=False,
+            algorithm="fed_avg", **_MOE_KWARGS,
+        )
+    )
+    config = _whole_mesh_config(
+        "ep_g", "MoETransformerClassificationModel", 16, gather=True,
+        algorithm="fed_avg", **_MOE_KWARGS,
+    )
+    ctx = _build_task(config)
+    session = SpmdExpertParallelSession(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+        expert_parallel=4,
+    )
+    assert session._selection_gather
+    assert session.s_pad == 2  # the selected count exactly: no slot axes
+    gathered = session.run()
+    _assert_bit_exact(dense, gathered, "ep_d", "ep_g", rounds=2)
+    # the gather program compiled once; the dense one never traced
+    assert session._jitted_gather_round_fn._cache_size() == 1
+    assert session._jitted_round_fn._cache_size() == 0
+
+
+def test_obd_expert_parallel_gather_vs_dense_bit_exact(tmp_session_dir):
+    """FedOBD on the expert-parallel layout: gather-vs-dense bit-exact
+    through the phase-2 switch, including the wire accounting and the
+    participation-merged phase-2 opt-state seeding (both paths now merge
+    by participation under an active selection, like the client-axis
+    session)."""
+    dense = train(
+        _whole_mesh_config(
+            "oep_d", "MoETransformerClassificationModel", 16, gather=False,
+            **_MOE_KWARGS,
+        )
+    )
+    gathered = train(
+        _whole_mesh_config(
+            "oep_g", "MoETransformerClassificationModel", 16, gather=True,
+            **_MOE_KWARGS,
+        )
+    )
+    assert set(dense["performance"]) == set(gathered["performance"])
+    for key in sorted(dense["performance"]):
+        a, b = dense["performance"][key], gathered["performance"][key]
+        assert a["test_accuracy"] == b["test_accuracy"], (key, a, b)
+        assert a["test_loss"] == b["test_loss"], (key, a, b)
+        if key > 0:
+            assert a["received_mb"] == b["received_mb"], key
+    pa = _final_params("oep_d", 3)
+    pb = _final_params("oep_g", 3)
+    for key in pa:
+        np.testing.assert_array_equal(pa[key], pb[key], err_msg=key)
+
+
+@pytest.mark.slow
+def test_obd_sequence_parallel_gather_vs_dense_bit_exact(tmp_session_dir):
+    """FedOBD on the sequence-parallel layout: the gather's per-leaf
+    sharding-preserving take keeps the sequence axis sharded through the
+    slot gather, and the trajectory matches the dense scan bit-exactly
+    across both phases.  (slow: the sp e2e pairs are the heaviest tiny
+    configs — same policy as the sequence_parallel_config suite.)"""
+    from conftest import LONGCONTEXT_SP_MODEL_KWARGS
+
+    sp_kwargs = dict(LONGCONTEXT_SP_MODEL_KWARGS)
+    dense = train(
+        _whole_mesh_config(
+            "osp_d", "LongContextTransformer", 64, gather=False, **sp_kwargs
+        )
+    )
+    gathered = train(
+        _whole_mesh_config(
+            "osp_g", "LongContextTransformer", 64, gather=True, **sp_kwargs
+        )
+    )
+    assert set(dense["performance"]) == set(gathered["performance"])
+    for key in sorted(dense["performance"]):
+        a, b = dense["performance"][key], gathered["performance"][key]
+        assert a["test_accuracy"] == b["test_accuracy"], (key, a, b)
+        assert a["test_loss"] == b["test_loss"], (key, a, b)
+    pa = _final_params("osp_d", 3)
+    pb = _final_params("osp_g", 3)
+    for key in pa:
+        np.testing.assert_array_equal(pa[key], pb[key], err_msg=key)
 
 
 def test_fsdp_falls_back_loudly(tmp_session_dir):
