@@ -925,21 +925,33 @@ def measure_fault_tolerance() -> dict:
     return out
 
 
-def measure_lint() -> int:
-    """Total jaxlint findings (audited included) from ``python -m
-    tools.jaxlint --format json`` — the analyzer-health count the bench
-    contract tracks.  Exits non-zero (un-audited findings) still yield
-    the count; only a crashed/unparseable run raises."""
+def _tool_total_findings(module: str, timeout: float) -> int:
+    """``python -m <module> --format json`` -> ``total_findings``.  A
+    dirty exit (un-audited findings) still yields the count; only a
+    crashed/unparseable run raises (main degrades that to -1)."""
     import subprocess
 
     proc = subprocess.run(
-        [sys.executable, "-m", "tools.jaxlint", "--format", "json"],
+        [sys.executable, "-m", module, "--format", "json"],
         cwd=os.path.dirname(os.path.abspath(__file__)),
         capture_output=True,
         text=True,
-        timeout=300,
+        timeout=timeout,
     )
     return int(json.loads(proc.stdout)["total_findings"])
+
+
+def measure_lint() -> int:
+    """Total jaxlint findings (audited included) — the analyzer-health
+    count the bench contract tracks."""
+    return _tool_total_findings("tools.jaxlint", timeout=300)
+
+
+def measure_shardcheck() -> int:
+    """Total shardcheck findings (audited included) — the
+    lowering-level certifier's health count over the full
+    session×layout×conf sweep."""
+    return _tool_total_findings("tools.shardcheck", timeout=900)
 
 
 def main() -> None:
@@ -1020,6 +1032,13 @@ def main() -> None:
         lint_findings = measure_lint()
     except Exception:
         lint_findings = -1
+    # certifier health: total shardcheck findings over the full
+    # session×layout×conf matrix (every one audited in
+    # tools/shardcheck/allowlist.txt — un-audited findings fail tier-1)
+    try:
+        shardcheck_findings = measure_shardcheck()
+    except Exception:
+        shardcheck_findings = -1
     # canonical north-star workloads (VERDICT r4 item 7): full
     # gtg_shapley_train.sh / fed_obd_train.sh runs are ~1 h on-chip, so
     # they are measured once per machine by tools/run_canonical.py and
@@ -1124,6 +1143,7 @@ def main() -> None:
                 "dropout_overhead_fraction": dropout_overhead,
                 "fault_tolerance": fault_tolerance,
                 "lint_findings": lint_findings,
+                "shardcheck_findings": shardcheck_findings,
                 "canonical": canonical,
             }
         )

@@ -1,0 +1,119 @@
+"""Pre-dispatch program introspection for ``tools/shardcheck``.
+
+A session's correctness contract is only partly visible in source text:
+the PR 8 opt-state-carry donation-aliasing mismatch and the ep/sp
+gather-stream init-ordering bug were *lowering-level* facts (layouts,
+jit cache entries) that no AST pass can see.  This module defines the
+neutral record a session hands the certifier BEFORE anything is
+dispatched: every jitted program it would run, with ABSTRACT arguments
+(``jax.ShapeDtypeStruct`` carrying the real shardings), its donated
+positions, its out-shardings pin, and the carry correspondence the
+donated buffers ride round-over-round.  The certifier then proves the
+sharding/donation/dispatch invariants with ``jax.eval_shape`` +
+``jax.jit(...).lower()`` — no execution, no training.
+
+The hooks that build these specs live on the sessions themselves
+(``SpmdFedAvgSession.shardcheck_programs`` and the sign-SGD/FedOBD
+overrides) so they cannot drift from the dispatch paths they describe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+
+@dataclasses.dataclass
+class ProgramSpec:
+    """One jitted program, described abstractly, pre-dispatch.
+
+    ``args``/``alt_args`` are pytrees of ``ShapeDtypeStruct`` (shardings
+    attached) matching exactly what the session's run loop would pass:
+    ``alt_args`` are additional probes (a different round's host-side
+    selection) that must hit the SAME jit cache entry.  ``carries`` maps
+    each donated argument position to the output subtree the run loop
+    feeds back into that position on the next dispatch — the pair whose
+    layouts must agree for donation to be sound.
+    """
+
+    name: str  #: e.g. ``round[dense]``, ``horizon[h=2]``
+    jitted: object  #: the jax.jit-wrapped callable (never called here)
+    args: tuple
+    donate_argnums: tuple = ()
+    mesh: object = None
+    #: out_shardings pin handed to jax.jit, or None (compiler-chosen)
+    out_pin: object = None
+    #: (donated argnum, fn(out_tree) -> fed-back subtree) pairs
+    carries: tuple = ()
+    #: same-signature probes — other rounds' abstract inputs
+    alt_args: tuple = ()
+    #: fused horizon length (0 = per-round program); when set,
+    #: ``stacked_out`` extracts the per-round-stacked metrics subtree
+    scanned_len: int = 0
+    stacked_out: object = None
+    #: ambient-mesh context factory wrapping trace/lower (use_mesh on
+    #: the expert-parallel layouts), or None
+    mesh_context: object = None
+
+
+@dataclasses.dataclass
+class DeclaredSpec:
+    """One declared (mesh, PartitionSpec) pair for the sharding-
+    vocabulary rule — checked structurally, before any NamedSharding
+    construction could mask an unknown axis name with a crash."""
+
+    label: str
+    mesh: object
+    spec: object  #: jax.sharding.PartitionSpec
+
+
+def abstract_tree(tree):
+    """``ShapeDtypeStruct`` twin of a placed array tree, shardings kept
+    — the no-execution stand-in the certifier lowers against."""
+
+    def one(x):
+        return jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+        )
+
+    return jax.tree.map(one, tree)
+
+
+def attach_shardings(shapes, shardings):
+    """Zip an ``eval_shape`` template with a matching sharding tree."""
+    return jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes,
+        shardings,
+    )
+
+
+def host_abstract(array, sharding):
+    """Abstract twin of a host numpy array the run loop would
+    ``put_sharded`` at ``sharding``."""
+    return jax.ShapeDtypeStruct(array.shape, array.dtype, sharding=sharding)
+
+
+def key_abstract(sharding=None, leading=()):
+    """Abstract PRNG key rows: ``leading + PRNGKey(0).shape``."""
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    return jax.ShapeDtypeStruct(
+        tuple(leading) + key.shape, key.dtype, sharding=sharding
+    )
+
+
+def named_sharding_decls(label, tree):
+    """DeclaredSpecs for every NamedSharding-placed leaf of ``tree``."""
+    decls = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        sharding = getattr(leaf, "sharding", None)
+        mesh = getattr(sharding, "mesh", None)
+        spec = getattr(sharding, "spec", None)
+        if mesh is not None and spec is not None:
+            decls.append(
+                DeclaredSpec(
+                    f"{label}{jax.tree_util.keystr(path)}", mesh, spec
+                )
+            )
+    return decls

@@ -683,21 +683,80 @@ class SpmdFedAvgSession:
         self._gather_program_fn = None
         self._jitted_gather_round_fn = None
         self._horizon_fns: dict[int, object] = {}
+        #: out_shardings pin handed to ``_wrap_round_programs`` (None =
+        #: compiler-chosen) — recorded so shardcheck can certify the
+        #: donated round-over-round layouts pre-dispatch
+        self._round_out_shardings = None
         self._round_fn = self._build_round_fn()
         if self.round_horizon > 1 and not self._horizon_capable():
             raise ValueError(
+                self._horizon_unsupported_reason()
+                or (
+                    "round_horizon > 1 requires a fusable round program;"
+                    f" {type(self).__name__} builds its own round"
+                    " function — run it with round_horizon=1"
+                )
+            )
+
+    # ---------------------------------------------------- capability gates
+    # The fused-round knobs (round_horizon / selection_gather /
+    # fault_tolerance.update_guard) are gated per session CLASS.  The
+    # class-level halves below are the single source of truth shared by
+    # the runtime gates AND the conf↔capability validator
+    # (``tools/shardcheck``): a misconfigured YAML fails at lint time
+    # with the exact reason the session would raise at round 1.
+
+    @classmethod
+    def _bespoke_round_program_reason(cls) -> str | None:
+        """Class-level core of every fused-knob gate: sessions that build
+        their own round programs without registering them through
+        :meth:`_wrap_round_programs` cannot fuse, gather, or guard.
+        Whole-mesh-per-client subclasses declare support via
+        ``_whole_mesh_fused``; sessions that extend the machinery to
+        their own round programs (FedOBD) override this."""
+        if cls is not SpmdFedAvgSession and not cls._whole_mesh_fused:
+            return f"{cls.__name__} builds its own round program"
+        return None
+
+    @classmethod
+    def _horizon_unsupported_reason(cls) -> str | None:
+        """Why ``round_horizon > 1`` cannot fuse this CLASS's rounds
+        (None = fusable) — the message ``__init__`` raises and the conf
+        validator reports."""
+        if cls is not SpmdFedAvgSession and not cls._whole_mesh_fused:
+            return (
                 "round_horizon > 1 requires a fusable round program;"
-                f" {type(self).__name__} builds its own round function —"
+                f" {cls.__name__} builds its own round function —"
                 " run it with round_horizon=1"
             )
+        return None
+
+    @classmethod
+    def _class_update_guard_reason(cls) -> str | None:
+        """Class-level update-guard gate (the pipeline session overrides
+        with its per-stage carve-out)."""
+        return cls._bespoke_round_program_reason()
+
+    @classmethod
+    def capability_gates(cls) -> dict[str, str | None]:
+        """The session class's static capability surface: fused-round
+        knob -> rejection reason (None = supported at the class level;
+        instance state such as FSDP can still fall back at runtime with
+        a logged warning).  Consumed by ``tools/shardcheck``'s
+        conf↔capability cross-validation."""
+        return {
+            "round_horizon": cls._horizon_unsupported_reason(),
+            "selection_gather": cls._bespoke_round_program_reason(),
+            "update_guard": cls._class_update_guard_reason(),
+        }
 
     def _selection_gather_unsupported_reason(self) -> str | None:
         """Why this session cannot run the selection-aware gather (None =
-        supported).  Whole-mesh-per-client subclasses declare support via
-        ``_whole_mesh_fused``; sessions that extend the gather to their
-        own round programs (FedOBD) override this."""
-        if type(self) is not SpmdFedAvgSession and not self._whole_mesh_fused:
-            return f"{type(self).__name__} builds its own round program"
+        supported): the class-level gate plus instance-state fallbacks
+        (FSDP stores params in the dense slot layout)."""
+        reason = self._bespoke_round_program_reason()
+        if reason is not None:
+            return reason
         if self._fsdp:
             return (
                 "FSDP model sharding stores params in the dense slot"
@@ -715,13 +774,9 @@ class SpmdFedAvgSession:
 
     def _update_guard_unsupported_reason(self) -> str | None:
         """Why this session cannot compile the device-side update guard
-        into its round program (None = supported).  Whole-mesh-per-client
-        subclasses declare support via ``_whole_mesh_fused``; sessions
-        that extend the guard to their own round programs (FedOBD)
-        override this."""
-        if type(self) is not SpmdFedAvgSession and not self._whole_mesh_fused:
-            return f"{type(self).__name__} builds its own round program"
-        return None
+        into its round program (None = supported) — delegates to the
+        class-level gate shared with the conf validator."""
+        return self._class_update_guard_reason()
 
     def _round_mesh_context(self):
         """Ambient-mesh context wrapping every program trace/dispatch —
@@ -1018,6 +1073,7 @@ class SpmdFedAvgSession:
         # the horizon builder scans this same program — one trace, shared
         # numerics with the per-round path
         self._round_program_fn = round_program
+        self._round_out_shardings = out_shardings
         jit_kwargs = (
             {"out_shardings": out_shardings} if out_shardings is not None else {}
         )
@@ -1314,6 +1370,156 @@ class SpmdFedAvgSession:
         gather path trains ``s_pad``."""
         trained = self.s_pad if self._selection_gather else self.n_slots
         return 1.0 - self._selected_per_round / max(trained, 1)
+
+    # ------------------------------------------------- shardcheck hooks
+    def shardcheck_shardings(self):
+        """Declared sharding vocabulary for ``tools/shardcheck``'s
+        mesh-axis-vocabulary rule: every (mesh, PartitionSpec) pair this
+        session stores or pins, checked structurally against the mesh's
+        axis names before any program is dispatched."""
+        from .introspect import DeclaredSpec, named_sharding_decls
+
+        decls = [
+            DeclaredSpec("slot_spec", self.mesh, self._slot_spec),
+            DeclaredSpec(
+                "horizon_weight_rows",
+                self.mesh,
+                self._horizon_weight_sharding.spec,
+            ),
+        ]
+        decls += [
+            DeclaredSpec(f"params[{k}]", self.mesh, spec)
+            for k, spec in self._param_specs.items()
+        ]
+        decls += named_sharding_decls("data", self._data)
+        if self._val_data is not None:
+            decls += named_sharding_decls("val", self._val_data)
+        return decls
+
+    def shardcheck_programs(self):
+        """Every jitted program this session's run loop would dispatch,
+        as abstract :class:`~.introspect.ProgramSpec` records: arguments
+        are ``ShapeDtypeStruct``s (real shardings attached) derived from
+        the resident stacks plus the HOST-side selection of rounds 1 and
+        2, so the certifier can ``eval_shape``/``lower`` the exact
+        programs — never execute them — and prove that consecutive
+        rounds share one jit cache entry."""
+        from .introspect import (
+            ProgramSpec,
+            abstract_tree,
+            attach_shardings,
+            host_abstract,
+            key_abstract,
+        )
+
+        specs = []
+        if getattr(self, "_jitted_round_fn", None) is None:
+            return specs  # bespoke round program: nothing registered
+        template = jax.eval_shape(
+            lambda: self.engine.init_params(self.config.seed)
+        )
+        params = attach_shardings(template, self._param_shardings)
+        data = abstract_tree(self._data)
+        val = abstract_tree(self._val_data or {})
+
+        def round_args(round_number):
+            if self._selection_gather:
+                idx, weights = self._select_indices(round_number)
+                return (
+                    params,
+                    host_abstract(weights, self._client_sharding),
+                    key_abstract(self._client_sharding, (self.s_pad,)),
+                    host_abstract(idx, self._client_sharding),
+                    data,
+                    val,
+                )
+            weights = self._select_weights(round_number)
+            return (
+                params,
+                host_abstract(weights, self._client_sharding),
+                key_abstract(self._client_sharding, (self.n_slots,)),
+                data,
+                val,
+            )
+
+        specs.append(
+            ProgramSpec(
+                name=(
+                    "round[gather]"
+                    if self._selection_gather
+                    else "round[dense]"
+                ),
+                jitted=(
+                    self._jitted_gather_round_fn
+                    if self._selection_gather
+                    else self._jitted_round_fn
+                ),
+                args=round_args(1),
+                alt_args=(round_args(2),),
+                donate_argnums=(0,),
+                mesh=self.mesh,
+                out_pin=self._round_out_shardings,
+                carries=((0, lambda out: out[0]),),
+                mesh_context=self._round_mesh_context,
+            )
+        )
+        if self._horizon_capable():
+            h = max(2, min(self.round_horizon, 4))
+            fn = self._horizon_fns.get(h)
+            if fn is None:
+                fn = self._horizon_fns[h] = self._build_horizon_fn(h)
+            eval_batches = abstract_tree(self._ensure_eval_batches())
+
+            def horizon_args(start_round):
+                if self._selection_gather:
+                    pairs = [
+                        self._select_indices(r)
+                        for r in range(start_round, start_round + h)
+                    ]
+                    weight_rows = np.stack([w for _i, w in pairs])
+                    idx_rows = host_abstract(
+                        np.stack([i for i, _w in pairs]),
+                        self._horizon_weight_sharding,
+                    )
+                else:
+                    idx_rows = None
+                    weight_rows = np.stack(
+                        [
+                            self._select_weights(r)
+                            for r in range(start_round, start_round + h)
+                        ]
+                    )
+                return (
+                    params,
+                    key_abstract(self._replicated),
+                    host_abstract(
+                        weight_rows, self._horizon_weight_sharding
+                    ),
+                    idx_rows,
+                    data,
+                    val,
+                    eval_batches,
+                )
+
+            specs.append(
+                ProgramSpec(
+                    name=f"horizon[h={h}]",
+                    jitted=fn._jitted,
+                    args=horizon_args(1),
+                    alt_args=(horizon_args(1 + h),),
+                    donate_argnums=(0, 1),
+                    mesh=self.mesh,
+                    out_pin=((self._param_shardings, None), None),
+                    carries=(
+                        (0, lambda out: out[0][0]),
+                        (1, lambda out: out[0][1]),
+                    ),
+                    scanned_len=h,
+                    stacked_out=lambda out: out[1],
+                    mesh_context=self._round_mesh_context,
+                )
+            )
+        return specs
 
     def _init_global_params(self):
         """Initial params + first round: resume from a previous session's
@@ -1909,6 +2115,8 @@ class SpmdSignSGDSession:
         self._run_program_fn = run_program
         # data as an argument, not a closure constant (see _build_round_fn)
         jitted = jax.jit(run_program, donate_argnums=(0,))
+        # bench/shardcheck introspection handle (pre-dispatch)
+        self._jitted_run_fn = jitted
 
         self._gather_program_fn = None
         self._jitted_gather_run_fn = None
@@ -2064,6 +2272,161 @@ class SpmdSignSGDSession:
         """See :meth:`SpmdFedAvgSession.wasted_compute_fraction`."""
         trained = self.s_pad if self._selection_gather else self.n_slots
         return 1.0 - self._selected_per_round / max(trained, 1)
+
+    # ------------------------------------------------- shardcheck hooks
+    @classmethod
+    def capability_gates(cls) -> dict[str, str | None]:
+        """Sign-SGD supports all three fused-round knobs (the guard is
+        the per-step vote-hygiene flavor) — see
+        :meth:`SpmdFedAvgSession.capability_gates`."""
+        return {
+            "round_horizon": None,
+            "selection_gather": None,
+            "update_guard": None,
+        }
+
+    def shardcheck_shardings(self):
+        """See :meth:`SpmdFedAvgSession.shardcheck_shardings`."""
+        from .introspect import DeclaredSpec, named_sharding_decls
+
+        decls = [
+            DeclaredSpec(
+                "client_slots", self.mesh, self._client_sharding.spec
+            )
+        ]
+        decls += named_sharding_decls("data", self._data)
+        return decls
+
+    def shardcheck_programs(self):
+        """See :meth:`SpmdFedAvgSession.shardcheck_programs` — the
+        sign-SGD whole-run program plus its gather twin and the fused
+        horizon, described abstractly."""
+        from ..engine.batching import make_epoch_batches
+        from .introspect import (
+            ProgramSpec,
+            abstract_tree,
+            host_abstract,
+            key_abstract,
+        )
+
+        template = jax.eval_shape(
+            lambda: self.engine.init_params(self.config.seed)
+        )
+        params = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(
+                s.shape, s.dtype, sharding=self._replicated
+            ),
+            template,
+        )
+        data = abstract_tree(self._data)
+        dense_weights = host_abstract(
+            (self._dataset_sizes > 0).astype(np.float32),
+            self._client_sharding,
+        )
+
+        def run_args(round_number):
+            if self._selection_gather:
+                idx, weights = self._select_indices(round_number)
+                return (
+                    params,
+                    host_abstract(weights, self._client_sharding),
+                    key_abstract(self._client_sharding, (self.s_pad,)),
+                    host_abstract(idx, self._client_sharding),
+                    data,
+                )
+            if self._per_round_weights:
+                weights = host_abstract(
+                    self._round_weights(round_number),
+                    self._client_sharding,
+                )
+            else:
+                weights = dense_weights
+            return (
+                params,
+                weights,
+                key_abstract(self._client_sharding, (self.n_slots,)),
+                data,
+            )
+
+        specs = [
+            ProgramSpec(
+                name=(
+                    "run[gather]"
+                    if self._selection_gather
+                    else "run[dense]"
+                ),
+                jitted=(
+                    self._jitted_gather_run_fn
+                    if self._selection_gather
+                    else self._jitted_run_fn
+                ),
+                args=run_args(1),
+                alt_args=(run_args(2),),
+                donate_argnums=(0,),
+                mesh=self.mesh,
+                carries=((0, lambda out: out[0]),),
+            )
+        ]
+        h = max(2, min(self.round_horizon, 4))
+        fn = self._horizon_fns.get(h)
+        if fn is None:
+            fn = self._horizon_fns[h] = self._build_horizon_fn(h)
+        test = self.dc.get_dataset(Phase.Test)
+        eval_batches = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                np.asarray(x).shape,
+                np.asarray(x).dtype,
+                sharding=self._replicated,
+            ),
+            make_epoch_batches(test, self.config.batch_size),
+        )
+        rng_sharding = NamedSharding(self.mesh, P(None, "clients"))
+
+        def horizon_args(start_round):
+            rounds = range(start_round, start_round + h)
+            if self._selection_gather:
+                pairs = [self._select_indices(r) for r in rounds]
+                idx_rows = host_abstract(
+                    np.stack([i for i, _w in pairs]), rng_sharding
+                )
+                weight_arg = host_abstract(
+                    np.stack([w for _i, w in pairs]), rng_sharding
+                )
+                slots = self.s_pad
+            elif self._per_round_weights:
+                idx_rows = None
+                weight_arg = host_abstract(
+                    np.stack([self._round_weights(r) for r in rounds]),
+                    rng_sharding,
+                )
+                slots = self.n_slots
+            else:
+                idx_rows = None
+                weight_arg = dense_weights
+                slots = self.n_slots
+            return (
+                params,
+                key_abstract(rng_sharding, (h, slots)),
+                weight_arg,
+                idx_rows,
+                data,
+                eval_batches,
+            )
+
+        specs.append(
+            ProgramSpec(
+                name=f"horizon[h={h}]",
+                jitted=fn._jitted,
+                args=horizon_args(1),
+                alt_args=(horizon_args(1 + h),),
+                donate_argnums=(0,),
+                mesh=self.mesh,
+                carries=((0, lambda out: out[0]),),
+                scanned_len=h,
+                stacked_out=lambda out: out[1],
+            )
+        )
+        return specs
 
     def _note_round(self, round_number: int, metric, epoch_metrics) -> None:
         """One round's stat row (identical surface on the per-round and

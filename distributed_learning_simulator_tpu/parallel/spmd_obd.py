@@ -85,6 +85,9 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         self._phase_program_fns: dict[bool, object] = {}
         self._gather_phase_program_fns: dict[bool, object] = {}
         self._obd_horizon_fns: dict[tuple[bool, int], object] = {}
+        #: out_shardings pins per phase (``_finish_obd_phase_fn``) — the
+        #: donated-layout record shardcheck certifies pre-dispatch
+        self._phase_out_shardings: dict[bool, object] = {}
         super().__init__(*args, **kwargs)
         # THE per-round client-key contract, shared with the threaded
         # fed_obd worker (engine/executor.py::obd_aligned_round_stream):
@@ -141,32 +144,41 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             type(self) is SpmdFedOBDSession or self._whole_mesh_fused
         )
 
-    def _selection_gather_unsupported_reason(self) -> str | None:
-        # the ep/sp whole-mesh scans route their phase programs through
-        # _finish_obd_phase_fn and support the gather (``_whole_mesh_fused``)
-        if type(self) is not SpmdFedOBDSession and not self._whole_mesh_fused:
+    @classmethod
+    def _bespoke_round_program_reason(cls) -> str | None:
+        # THE class-level OBD gate (selection gather, horizon fusion and
+        # the update guard all key off it, here and in tools/shardcheck's
+        # conf validator): every layout whose phase programs flow through
+        # _finish_obd_phase_fn — the client-axis session and the ep/sp
+        # whole-mesh scans — gets the full fused machinery
+        if cls is not SpmdFedOBDSession and not cls._whole_mesh_fused:
             return (
-                f"{type(self).__name__} lays clients out as a"
+                f"{cls.__name__} lays clients out as a"
                 " whole-mesh-per-client scan (own phase programs)"
             )
         return None
 
+    @classmethod
+    def _horizon_unsupported_reason(cls) -> str | None:
+        reason = cls._bespoke_round_program_reason()
+        if reason is None:
+            return None
+        return (
+            "round_horizon > 1 requires a fusable round program;"
+            f" {reason} — run it with round_horizon=1"
+        )
+
+    def _selection_gather_unsupported_reason(self) -> str | None:
+        return self._bespoke_round_program_reason()
+
     def _horizon_capable(self) -> bool:
-        # every OBD layout whose phase programs flow through
-        # _finish_obd_phase_fn fuses same-phase rounds (the client-axis
-        # session and the ep/sp whole-mesh scans)
-        return type(self) is SpmdFedOBDSession or self._whole_mesh_fused
+        return self._bespoke_round_program_reason() is None
 
     def _update_guard_unsupported_reason(self) -> str | None:
         # the phase programs compile the guard in (per-client upload
         # hygiene + survivor-renormalized total) on the client-axis AND
         # whole-mesh layouts (obd_scan_round_program's guard mode)
-        if type(self) is not SpmdFedOBDSession and not self._whole_mesh_fused:
-            return (
-                f"{type(self).__name__} lays clients out as a"
-                " whole-mesh-per-client scan (own phase programs)"
-            )
-        return None
+        return self._bespoke_round_program_reason()
 
     def _opt_carry_out_sharding(self):
         """out_shardings pin for the per-slot opt-state carry.  The
@@ -533,6 +545,7 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         # the horizon builder scans this same program — one trace, shared
         # numerics with the per-round path
         self._phase_program_fns[phase_two] = round_program
+        self._phase_out_shardings[phase_two] = out_shardings
         jit_kwargs = (
             {"out_shardings": out_shardings} if out_shardings is not None else {}
         )
@@ -709,6 +722,206 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
 
         fn._jitted = jitted
         return fn
+
+    # ------------------------------------------------- shardcheck hooks
+    def shardcheck_shardings(self):
+        """Base declarations plus the per-slot opt-state carry layout and
+        its out_shardings pin (the PR 8 donation-aliasing bug class)."""
+        from .introspect import DeclaredSpec
+
+        decls = super().shardcheck_shardings()
+        decls.append(
+            DeclaredSpec(
+                "opt_carry", self.mesh, self._client_sharding.spec
+            )
+        )
+        pin = self._opt_carry_out_sharding()
+        if pin is not None:
+            decls.append(
+                DeclaredSpec("opt_carry_pin", self.mesh, pin.spec)
+            )
+        return decls
+
+    def shardcheck_programs(self):
+        """The OBD dispatch inventory: both phase programs (dense or
+        gather, exactly as ``run()`` would dispatch them) plus the fused
+        same-phase horizons, described abstractly — see
+        :meth:`SpmdFedAvgSession.shardcheck_programs`."""
+        from .introspect import (
+            ProgramSpec,
+            abstract_tree,
+            attach_shardings,
+            host_abstract,
+            key_abstract,
+        )
+
+        template = jax.eval_shape(
+            lambda: self.engine.init_params(self.config.seed)
+        )
+        params = attach_shardings(template, self._param_shardings)
+        data = abstract_tree(self._data)
+        opt_abstract = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(
+                s.shape, s.dtype, sharding=self._client_sharding
+            ),
+            self._opt_state_template(),
+        )
+        bcast_rng = key_abstract(self._replicated)
+        if self._phase2_fn is None:
+            self._phase2_fn = self._build_phase_fn(phase_two=True)
+        specs = []
+
+        def dense_args(weights, use_opt):
+            return (
+                params,
+                opt_abstract if use_opt else None,
+                host_abstract(weights, self._client_sharding),
+                key_abstract(self._client_sharding, (self.n_slots,)),
+                bcast_rng,
+                data,
+            )
+
+        def gather_args(round_number):
+            idx, weights = self._select_indices(round_number)
+            return (
+                params,
+                opt_abstract,
+                host_abstract(weights, self._client_sharding),
+                key_abstract(self._client_sharding, (self.s_pad,)),
+                host_abstract(idx, self._client_sharding),
+                bcast_rng,
+                data,
+            )
+
+        def carries(use_opt):
+            # the run loop feeds the BROADCAST (out[1]) back as the next
+            # round's params and the merged opt buffer (out[2]) back as
+            # the carry
+            pairs = ((0, lambda out: out[1]),)
+            if use_opt:
+                pairs = pairs + ((1, lambda out: out[2]),)
+            return pairs
+
+        p1_opt = self._phase1_carries_opt
+        if self._selection_gather:
+            specs.append(
+                ProgramSpec(
+                    name="phase1[gather]",
+                    jitted=self._phase1_fn._jitted_gather,
+                    args=gather_args(1),
+                    alt_args=(gather_args(2),),
+                    donate_argnums=(0, 1),
+                    mesh=self.mesh,
+                    out_pin=self._phase_out_shardings.get(False),
+                    carries=carries(True),
+                    mesh_context=self._round_mesh_context,
+                )
+            )
+        else:
+            specs.append(
+                ProgramSpec(
+                    name="phase1[dense]",
+                    jitted=self._phase1_fn._jitted,
+                    args=dense_args(self._select_weights(1), p1_opt),
+                    alt_args=(
+                        dense_args(self._select_weights(2), p1_opt),
+                    ),
+                    donate_argnums=(0, 1) if p1_opt else (0,),
+                    mesh=self.mesh,
+                    out_pin=self._phase_out_shardings.get(False),
+                    carries=carries(p1_opt),
+                    mesh_context=self._round_mesh_context,
+                )
+            )
+        phase2_weights = self._dataset_sizes.astype(np.float32)
+        specs.append(
+            ProgramSpec(
+                name="phase2[dense]",
+                jitted=self._phase2_fn._jitted,
+                args=dense_args(phase2_weights, True),
+                alt_args=(dense_args(phase2_weights, True),),
+                donate_argnums=(0, 1),
+                mesh=self.mesh,
+                out_pin=self._phase_out_shardings.get(True),
+                carries=carries(True),
+                mesh_context=self._round_mesh_context,
+            )
+        )
+        if not self._horizon_capable():
+            return specs
+        h = 2
+        eval_batches = abstract_tree(self._ensure_eval_batches())
+        horizon_pin = (
+            (
+                self._param_shardings,
+                self._param_shardings,
+                self._opt_carry_out_sharding(),
+                None,
+            ),
+            None,
+        )
+        horizon_carries = (
+            (0, lambda out: out[0][1]),
+            (1, lambda out: out[0][2]),
+            (2, lambda out: out[0][3]),
+        )
+        for phase_two in (False, True):
+            fn = self._obd_horizon_fns.get((phase_two, h))
+            if fn is None:
+                fn = self._obd_horizon_fns[(phase_two, h)] = (
+                    self._build_obd_horizon_fn(phase_two, h)
+                )
+            use_gather = self._selection_gather and not phase_two
+
+            def horizon_args(start_round, phase_two=phase_two,
+                             use_gather=use_gather):
+                rounds = range(start_round, start_round + h)
+                idx_rows = None
+                if phase_two:
+                    weight_rows = np.stack([phase2_weights] * h)
+                elif use_gather:
+                    pairs = [self._select_indices(r) for r in rounds]
+                    weight_rows = np.stack([w for _i, w in pairs])
+                    idx_rows = host_abstract(
+                        np.stack([i for i, _w in pairs]),
+                        self._horizon_weight_sharding,
+                    )
+                else:
+                    weight_rows = np.stack(
+                        [self._select_weights(r) for r in rounds]
+                    )
+                return (
+                    params,
+                    opt_abstract,
+                    key_abstract(self._replicated),
+                    host_abstract(
+                        weight_rows, self._horizon_weight_sharding
+                    ),
+                    idx_rows,
+                    data,
+                    eval_batches,
+                )
+
+            specs.append(
+                ProgramSpec(
+                    name=(
+                        f"horizon[phase2,h={h}]"
+                        if phase_two
+                        else f"horizon[phase1,h={h}]"
+                    ),
+                    jitted=fn._jitted,
+                    args=horizon_args(1),
+                    alt_args=(horizon_args(1 + h),),
+                    donate_argnums=(0, 1, 2),
+                    mesh=self.mesh,
+                    out_pin=horizon_pin,
+                    carries=horizon_carries,
+                    scanned_len=h,
+                    stacked_out=lambda out: out[1],
+                    mesh_context=self._round_mesh_context,
+                )
+            )
+        return specs
 
     # ------------------------------------------------------------------
     def _opt_state_template(self):

@@ -126,14 +126,17 @@ class SpmdPipelineSession(SingleDeviceEvalMixin, SpmdFedAvgSession):
             return P("pp")
         return P()
 
-    def _update_guard_unsupported_reason(self) -> str | None:
+    @classmethod
+    def _class_update_guard_reason(cls) -> str | None:
         # inside the session shard_map the trunk params are per-STAGE
         # local slices: a client's delta norm/finiteness check would be
         # stage-local and could disagree across devices (divergent
         # effective weights -> divergent aggregates).  The ep/sp layouts
         # see full deltas (GSPMD global ops / replicated params) and
         # support the guard; pipeline keeps the loud rejection until the
-        # guard grows a cross-stage reduction.
+        # guard grows a cross-stage reduction.  Class-level so the conf
+        # validator (tools/shardcheck) reports the same reason at lint
+        # time that ``__init__`` raises at round 1.
         return (
             "the pipeline session's trunk params are per-stage local"
             " slices inside shard_map — the per-client delta hygiene"

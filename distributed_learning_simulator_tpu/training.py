@@ -621,6 +621,93 @@ SPMD_SESSION_BUILDERS = {
 
 SPMD_METHODS = frozenset(SPMD_SESSION_BUILDERS)
 
+#: algorithm name -> (module, class) the builders above construct —
+#: resolution-only twin of SPMD_SESSION_BUILDERS for introspection
+#: (tools/shardcheck's conf↔capability validator) that must never
+#: import datasets/models/devices.  Kept key-identical to the builder
+#: table (asserted below) so a method added to one cannot be missed by
+#: the other.
+_SPMD_SESSION_CLASS_PATHS = {
+    "fed_avg": ("parallel.spmd", "SpmdFedAvgSession"),
+    "fed_paq": ("parallel.spmd", "SpmdFedAvgSession"),
+    "sign_SGD": ("parallel.spmd", "SpmdSignSGDSession"),
+    "fed_obd": ("parallel.spmd_obd", "SpmdFedOBDSession"),
+    "fed_obd_sq": ("parallel.spmd_obd", "SpmdFedOBDSession"),
+    "fed_gnn": ("parallel.spmd_gnn", "SpmdFedGNNSession"),
+    "fed_gcn": ("parallel.spmd_gnn", "SpmdFedGNNSession"),
+    "fed_aas": ("parallel.spmd_gnn", "SpmdFedAASSession"),
+    "fed_dropout_avg": ("parallel.spmd_sparse", "SpmdFedDropoutAvgSession"),
+    "single_model_afd": ("parallel.spmd_sparse", "SpmdSMAFDSession"),
+    "GTG_shapley_value": ("parallel.spmd_shapley", "SpmdShapleySession"),
+    "multiround_shapley_value": ("parallel.spmd_shapley", "SpmdShapleySession"),
+    "Hierarchical_shapley_value": (
+        "parallel.spmd_shapley",
+        "SpmdShapleySession",
+    ),
+}
+assert set(_SPMD_SESSION_CLASS_PATHS) == SPMD_METHODS, (
+    "SPMD session class table out of sync with the builder table"
+)
+
+
+def resolve_spmd_session_class(config):
+    """The session CLASS ``_make_spmd_session`` would construct for this
+    config, or None when :func:`resolve_executor` picks the threaded
+    path — resolution only: no datasets, models, or devices are touched,
+    so ``tools/shardcheck`` can cross-validate every ``conf/**/*.yaml``
+    knob against the class's ``capability_gates`` at lint time.  Raises
+    the same ``ValueError``/``NotImplementedError`` the runtime wiring
+    would (invalid layout×method combinations fail here with the honest
+    reason)."""
+    import importlib
+
+    if resolve_executor(config) != "spmd":
+        return None
+    model_kwargs = dict(config.model_kwargs)
+    algorithm = config.distributed_algorithm
+
+    def load(module, name):
+        mod = importlib.import_module(f".{module}", package=__package__)
+        return getattr(mod, name)
+
+    if int(model_kwargs.get("pipeline_stages", 0)) > 1:
+        return load("parallel.spmd_pp", "SpmdPipelineSession")
+    if int(model_kwargs.get("expert_parallel", 0)):
+        if int(model_kwargs.get("sequence_parallel", 0)):
+            raise ValueError(
+                "expert_parallel and sequence_parallel are separate "
+                "session layouts; set one (composing them is a mesh "
+                "design choice the YAML surface does not expose)"
+            )
+        if algorithm in ("fed_obd", "fed_obd_sq"):
+            return load(
+                "parallel.spmd_obd_ep", "SpmdFedOBDExpertParallelSession"
+            )
+        return load("parallel.spmd_ep", "SpmdExpertParallelSession")
+    if int(model_kwargs.get("sequence_parallel", 0)):
+        if algorithm == "fed_avg":
+            return load("parallel.spmd_sp", "SpmdSequenceParallelSession")
+        if algorithm in ("fed_obd", "fed_obd_sq"):
+            return load(
+                "parallel.spmd_obd_sp", "SpmdFedOBDSequenceParallelSession"
+            )
+        raise ValueError(
+            "sequence_parallel under executor=spmd is implemented for "
+            "fed_avg (parallel/spmd_sp.py) and fed_obd/fed_obd_sq "
+            "(parallel/spmd_obd_sp.py); other methods run it on the "
+            "threaded executor, where each client's jitted step owns "
+            "the model's sp shard_map (executor auto does this)"
+        )
+    path = _SPMD_SESSION_CLASS_PATHS.get(algorithm)
+    if path is None:
+        raise NotImplementedError(
+            f"no SPMD round program for {algorithm!r} (every built-in "
+            "method has one; for custom registrations drop executor=spmd "
+            "and use the threaded executor)"
+        )
+    return load(*path)
+
+
 _EXECUTORS = ("auto", "spmd", "sequential")
 
 

@@ -4,10 +4,14 @@ set -e
 
 run() { python3 ./simulator.py "$@"; }
 
-# correctness gate ahead of the smoke runs (and of pytest in CI): the
-# jaxlint sweep must be clean — zero un-audited findings, no stale
-# allowlist entries (tools/jaxlint, docs/jax_hazards.md)
+# correctness gates ahead of the smoke runs (and of pytest in CI):
+# the jaxlint sweep must be clean — zero un-audited findings, no stale
+# allowlist entries (tools/jaxlint, docs/jax_hazards.md) — and
+# shardcheck must certify the full session×layout×conf matrix at the
+# lowering level (sharding vocabulary, donation soundness, dispatch
+# budgets, conf↔capability; tools/shardcheck)
 python3 -m tools.jaxlint
+python3 -m tools.shardcheck
 
 for cfg in fed_avg/mnist fed_avg/imdb; do
   algo=${cfg%%/*}

@@ -118,6 +118,7 @@ def test_bench_main_prints_one_json_line(monkeypatch):
         },
     )
     monkeypatch.setattr(bench, "measure_lint", lambda: 38)
+    monkeypatch.setattr(bench, "measure_shardcheck", lambda: 0)
     monkeypatch.setattr(
         bench,
         "measure_fault_tolerance",
@@ -160,6 +161,7 @@ def test_bench_main_prints_one_json_line(monkeypatch):
         "dropout_overhead_fraction",
         "fault_tolerance",
         "lint_findings",
+        "shardcheck_findings",
     ):
         assert field in payload, field
     assert payload["metric"] == "fedavg_cifar10_100clients_rounds_per_sec"
@@ -202,6 +204,9 @@ def test_bench_main_prints_one_json_line(monkeypatch):
     # analyzer health: the audited jaxlint finding count (count only —
     # the per-finding detail lives in the analyzer's own JSON output)
     assert payload["lint_findings"] == 38
+    # certifier health: the audited shardcheck finding count over the
+    # full session×layout×conf sweep (same count-only convention)
+    assert payload["shardcheck_findings"] == 0
 
 
 def test_bench_main_survives_measurement_failures(monkeypatch):
@@ -224,6 +229,7 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     monkeypatch.setattr(bench, "measure_selection_gather", boom)
     monkeypatch.setattr(bench, "measure_fault_tolerance", boom)
     monkeypatch.setattr(bench, "measure_lint", boom)
+    monkeypatch.setattr(bench, "measure_shardcheck", boom)
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     bench.main()
@@ -259,3 +265,5 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     assert payload["dropout_overhead_fraction"] == -1.0
     # lint count degrades to -1 (never a missing field, never a crash)
     assert payload["lint_findings"] == -1
+    # shardcheck count degrades the same way (-1/absent-never)
+    assert payload["shardcheck_findings"] == -1

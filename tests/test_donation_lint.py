@@ -1,22 +1,39 @@
-"""Donation-aliasing lint (``tools/donation_lint.py``) pinned in tier-1.
+"""The donation-aliasing device-put lint, pinned in tier-1 — now keyed
+DIRECTLY on the jaxlint sub-rule (``use-after-donate/device-put``,
+``tools/jaxlint/rules/use_after_donate.py``); the ``tools/donation_lint``
+compat shim is retired (docs/migrating.md).
 
 The bug class: ``jax.device_put`` of an aligned host numpy array returns
 a zero-copy VIEW on the cpu backend; if that result flows into a jitted
 program's DONATED argument, XLA reuses memory python still owns — the
-``_place_params`` NaN/segfault PR 2 fixed.  The lint enumerates every
+``_place_params`` NaN/segfault PR 2 fixed.  The sub-rule enumerates every
 ``jax.device_put`` call not wrapped in an intervening ``jnp.copy``; this
-test pins the result against the audited allowlist below.  A NEW
-un-audited ``device_put`` fails here until someone audits it (add it
-with a justification comment) — and a removed site must be cleaned up.
+test pins the result against the audited allowlist below in the
+historical ``<relpath>::<enclosing def>`` key format.  A NEW un-audited
+``device_put`` fails here until someone audits it (add it with a
+justification comment) — and a removed site must be cleaned up.
 """
 
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, os.path.join(REPO, "tools"))
+sys.path.insert(0, REPO)
 
-from donation_lint import find_unwrapped_device_put  # noqa: E402
+from tools.jaxlint.engine import iter_file_contexts  # noqa: E402
+from tools.jaxlint.rules.use_after_donate import device_put_sites  # noqa: E402
+
+
+def find_unwrapped_device_put(pkg_root: str) -> list[str]:
+    """``<relpath>::<enclosing def>`` for every ``jax.device_put`` call
+    not wrapped in a copy within its own expression, sorted — the
+    historical donation_lint contract, served by the jaxlint sub-rule."""
+    findings: set[str] = set()
+    for ctx in iter_file_contexts([pkg_root]):
+        for finding in device_put_sites(ctx):
+            findings.add(f"{finding.path}::{finding.scope}")
+    return sorted(findings)
+
 
 #: every audited-good ``jax.device_put`` site, with why it cannot feed a
 #: donated argument an aliased host buffer
@@ -63,7 +80,7 @@ def test_device_put_sites_are_audited():
 
 
 def test_lint_flags_unwrapped_and_accepts_copied(tmp_path):
-    """The lint's own contract: a bare device_put is flagged, a
+    """The sub-rule's own contract: a bare device_put is flagged, a
     jnp.copy/tree.map(jnp.copy, ...) wrap is not."""
     pkg = tmp_path / "fakepkg"
     pkg.mkdir()

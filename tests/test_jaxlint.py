@@ -8,7 +8,7 @@ Three contracts:
   reconstructions (PR 2 donation aliasing, PR 3 zero-copy snapshot,
   PR 4 count-dependent split) are detected — reintroducing any of those
   bug classes trips the analyzer;
-* **repo-wide pin** — all five rules over the package produce ZERO
+* **repo-wide pin** — all seven rules over the package produce ZERO
   un-audited findings against ``tools/jaxlint/allowlist.txt``, and no
   allowlist entry is stale.  A new finding fails here until the code is
   fixed or the site is audited WITH a written justification;
@@ -16,7 +16,7 @@ Three contracts:
   duplicate entries are load errors.
 
 ``tests/test_donation_lint.py`` keeps pinning the device-put sub-rule
-through the compat shim.
+directly (the ``tools/donation_lint`` compat shim is retired).
 """
 
 import json
@@ -114,7 +114,7 @@ def test_finding_keys_are_relpath_scope_rule():
 
 # ---------------------------------------------------------------- tier-1 pin
 def test_package_zero_unaudited_findings():
-    """THE standing pin: all five rules over the whole package, every
+    """THE standing pin: all seven rules over the whole package, every
     finding audited, no stale audit."""
     findings = run_rules([PACKAGE], [cls() for cls in RULES.values()])
     allow = load_allowlist(DEFAULT_ALLOWLIST)

@@ -1,0 +1,400 @@
+"""shardcheck (``tools/shardcheck``) pinned in tier-1.
+
+Four contracts:
+
+* **matrix certification** — every fast-tier session×layout cell
+  (fed_avg/fed_paq/sign_SGD/fed_obd client-axis + fed_avg ep) lowers
+  clean under all three program rules; the slow whole-mesh cells ride
+  the slow marker and the ``test.sh``/CLI full sweep;
+* **corpus detection** — the PR 8 opt-carry donation-aliasing layout
+  reconstruction and the fabricated ``PartitionSpec("expert")``-on-a-
+  client-mesh mistake are both FLAGGED if reintroduced (the checker's
+  reason to exist);
+* **conf sweep** — every ``conf/**/*.yaml`` (incl. ``large_scale/``)
+  passes the capability validator, and the known-bad combinations
+  (pipeline+update_guard, smafd/Shapley+round_horizon) fail with the
+  session's stated reason;
+* **CLI/allowlist hygiene** — ``python -m tools.shardcheck`` emits the
+  machine-readable summary bench.py consumes, keyed
+  ``session::layout::rule`` against the audited allowlist.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tools.jaxlint.allowlist import load_allowlist  # noqa: E402
+from tools.shardcheck import (  # noqa: E402
+    DEFAULT_ALLOWLIST,
+    RULES,
+    certify_cell,
+    certify_specs,
+    select_cells,
+    validate_config,
+    validate_conf_tree,
+)
+from tools.shardcheck.corpus import CASES  # noqa: E402
+
+from distributed_learning_simulator_tpu.config import (  # noqa: E402
+    DistributedTrainingConfig,
+)
+
+
+# ------------------------------------------------------------- the matrix
+@pytest.mark.parametrize(
+    "cell", select_cells(tiers=("fast",)), ids=lambda c: c.key
+)
+def test_fast_matrix_cell_certifies(cell, tmp_session_dir):
+    findings = certify_cell(cell, save_dir=None)
+    assert not findings, [f.as_dict() for f in findings]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "cell", select_cells(tiers=("slow",)), ids=lambda c: c.key
+)
+def test_full_matrix_cell_certifies(cell, tmp_session_dir):
+    findings = certify_cell(cell, save_dir=None)
+    assert not findings, [f.as_dict() for f in findings]
+
+
+# --------------------------------------------------------------- corpus
+@pytest.mark.parametrize("case", sorted(CASES), ids=str)
+def test_corpus_reconstructions_detected(case):
+    """Reintroducing the PR 8 opt-carry layout bug (or the fabricated
+    mesh-axis typo) must trip the certifier — pinned in tier-1."""
+    module = CASES[case]
+    specs, decls = module.build()
+    findings = certify_specs(case, "corpus", specs, decls)
+    assert any(f.rule == module.RULE for f in findings), (
+        case,
+        [f.as_dict() for f in findings],
+    )
+
+
+def test_finding_keys_are_session_layout_rule():
+    specs, decls = CASES["pr8_opt_carry_layout"].build()
+    findings = certify_specs("fed_obd", "ep", specs, decls)
+    assert findings
+    for f in findings:
+        assert f.key.count("::") == 2, f.key
+        assert f.key == f"fed_obd::ep::{f.rule}"
+        assert f.rule in RULES
+
+
+# ------------------------------------------------------ rule unit pins
+def test_hooks_register_a_nonempty_program_inventory(tmp_session_dir):
+    """Certification must never be vacuous: the client-axis fed_avg
+    session's hooks expose the round program AND a fused horizon (plus
+    sharding declarations), and certify_cell turns an empty inventory
+    into a finding instead of a clean pass."""
+    from tools.shardcheck.matrix import build_session, select_cells
+    from tools.shardcheck.checks import Finding
+
+    cell = select_cells(sessions=("fed_avg",), layouts=("client_axis",))[0]
+    session = build_session(cell, save_dir=str(tmp_session_dir / "cell"))
+    specs = session.shardcheck_programs()
+    names = [s.name for s in specs]
+    assert any(n.startswith("round[") for n in names), names
+    assert any(n.startswith("horizon[") for n in names), names
+    assert session.shardcheck_shardings()
+    # the vacuous-inventory guard
+    session.shardcheck_programs = lambda: []
+    from tools.shardcheck import matrix as matrix_mod
+
+    original = matrix_mod.build_session
+    matrix_mod.build_session = lambda *a, **k: session
+    try:
+        findings = matrix_mod.certify_cell(cell)
+    finally:
+        matrix_mod.build_session = original
+    assert findings and isinstance(findings[0], Finding)
+    assert "vacuous" in findings[0].message
+def test_dispatch_budget_flags_signature_drift():
+    """A program whose round-2 inputs change shape (a selection-count-
+    dependent padding, say) compiles per round — the rule must flag it
+    without ever compiling the program."""
+    from distributed_learning_simulator_tpu.parallel.introspect import (
+        ProgramSpec,
+    )
+
+    jitted = jax.jit(lambda w: w * 2)
+    spec = ProgramSpec(
+        name="round",
+        jitted=jitted,
+        args=(jax.ShapeDtypeStruct((4,), jnp.float32),),
+        alt_args=((jax.ShapeDtypeStruct((6,), jnp.float32),),),
+        mesh=None,
+    )
+    findings = certify_specs(
+        "synthetic",
+        "unit",
+        [spec],
+        rules=("dispatch-budget",),
+        compile_programs=False,
+    )
+    assert any(
+        f.rule == "dispatch-budget" and "cache entry" in f.message
+        for f in findings
+    ), [f.as_dict() for f in findings]
+
+
+def test_donation_soundness_flags_pin_mismatch_structurally():
+    """A donated carry whose declared out_shardings pin disagrees with
+    its input layout is flagged by the structural half of the rule —
+    no compile needed."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from distributed_learning_simulator_tpu.parallel.introspect import (
+        ProgramSpec,
+    )
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]), axis_names=("ep",))
+    replicated = NamedSharding(mesh, P())
+    sharded = NamedSharding(mesh, P("ep"))
+    jitted = jax.jit(lambda c: c, donate_argnums=(0,), out_shardings=sharded)
+    spec = ProgramSpec(
+        name="carry",
+        jitted=jitted,
+        args=(jax.ShapeDtypeStruct((4,), jnp.float32, sharding=replicated),),
+        donate_argnums=(0,),
+        mesh=mesh,
+        out_pin=sharded,
+        carries=((0, lambda out: out),),
+    )
+    findings = certify_specs(
+        "synthetic",
+        "unit",
+        [spec],
+        rules=("donation-soundness",),
+        compile_programs=False,
+    )
+    assert any(
+        f.rule == "donation-soundness" and "PR 8" in f.message
+        for f in findings
+    ), [f.as_dict() for f in findings]
+
+
+# ------------------------------------------------------------ conf sweep
+def test_conf_tree_passes_capability_validator():
+    """Every shipped conf (incl. large_scale/) is capability-clean."""
+    findings = validate_conf_tree()
+    assert not findings, [f.as_dict() for f in findings]
+
+
+def _synthetic_config(**overrides):
+    config = DistributedTrainingConfig(
+        dataset_name="MNIST",
+        model_name="LeNet5",
+        distributed_algorithm="fed_avg",
+        optimizer_name="SGD",
+        worker_number=4,
+        batch_size=8,
+        round=2,
+        epoch=1,
+        executor="spmd",
+        save_dir="unused",
+    )
+    for key, value in overrides.items():
+        setattr(config, key, value)
+    return config
+
+
+def test_pipeline_update_guard_pinned_to_fail_with_stated_reason():
+    """The pipeline session's guard carve-out surfaces at lint time with
+    the SAME reason its __init__ raises at round 1."""
+    config = _synthetic_config(
+        model_kwargs={"pipeline_stages": 2},
+        fault_tolerance={"update_guard": True},
+    )
+    findings = validate_config(config, "synthetic/pipeline_guard")
+    assert any(
+        f.rule == "conf-capability"
+        and "per-stage local" in f.message
+        and "SpmdPipelineSession" in f.message
+        for f in findings
+    ), [f.as_dict() for f in findings]
+
+
+@pytest.mark.parametrize(
+    "algorithm, session_name",
+    [
+        ("single_model_afd", "SpmdSMAFDSession"),
+        ("GTG_shapley_value", "SpmdShapleySession"),
+        ("Hierarchical_shapley_value", "SpmdShapleySession"),
+    ],
+)
+def test_smafd_and_shapley_round_horizon_pinned_to_fail(
+    algorithm, session_name
+):
+    """round_horizon on the bespoke-round-program sessions fails at lint
+    time with the session's honest rejection (the message __init__
+    raises)."""
+    config = _synthetic_config(
+        distributed_algorithm=algorithm,
+        algorithm_kwargs={"round_horizon": 5},
+    )
+    findings = validate_config(config, f"synthetic/{algorithm}")
+    assert any(
+        f.rule == "conf-capability"
+        and session_name in f.message
+        and "builds its own round function" in f.message
+        for f in findings
+    ), [f.as_dict() for f in findings]
+
+
+def test_gnn_round_horizon_flagged_without_capability_gates():
+    """Sessions that never grew the fused machinery (GNN) are flagged
+    via the capability_gates-undeclared default — the knob would be
+    silently ignored at runtime."""
+    config = _synthetic_config(
+        distributed_algorithm="fed_gnn",
+        dataset_name="cs",
+        model_name="GCN",
+        algorithm_kwargs={"round_horizon": 4},
+    )
+    findings = validate_config(config, "synthetic/fed_gnn")
+    assert any(
+        "no fused-round machinery" in f.message for f in findings
+    ), [f.as_dict() for f in findings]
+
+
+def test_selection_gather_full_participation_flagged():
+    config = _synthetic_config(
+        algorithm_kwargs={"selection_gather": True},
+    )
+    findings = validate_config(config, "synthetic/full_participation")
+    assert any(
+        "full participation" in f.message for f in findings
+    ), [f.as_dict() for f in findings]
+
+
+def test_impossible_quorum_flagged():
+    config = _synthetic_config(
+        algorithm_kwargs={"min_client_quorum": 9},
+    )
+    findings = validate_config(config, "synthetic/quorum")
+    assert any(
+        "no round can ever meet quorum" in f.message for f in findings
+    ), [f.as_dict() for f in findings]
+
+
+def test_unknown_fault_tolerance_key_flagged():
+    config = _synthetic_config(
+        fault_tolerance={"droput_rate": 0.3},  # the typo class
+    )
+    findings = validate_config(config, "synthetic/ft_typo")
+    assert any(
+        "fault_tolerance rejected" in f.message for f in findings
+    ), [f.as_dict() for f in findings]
+
+
+def test_session_class_table_in_sync_with_builders():
+    from distributed_learning_simulator_tpu.training import (
+        _SPMD_SESSION_CLASS_PATHS,
+        SPMD_SESSION_BUILDERS,
+    )
+
+    assert set(_SPMD_SESSION_CLASS_PATHS) == set(SPMD_SESSION_BUILDERS)
+
+
+def test_capability_gates_match_runtime_gate_strings():
+    """The conf validator's reasons ARE the runtime reasons — one
+    source of truth (the class-level gates the instance gates call)."""
+    from distributed_learning_simulator_tpu.parallel.spmd import (
+        SpmdFedAvgSession,
+    )
+    from distributed_learning_simulator_tpu.parallel.spmd_obd import (
+        SpmdFedOBDSession,
+    )
+    from distributed_learning_simulator_tpu.parallel.spmd_pp import (
+        SpmdPipelineSession,
+    )
+    from distributed_learning_simulator_tpu.parallel.spmd_sparse import (
+        SpmdSMAFDSession,
+    )
+
+    assert SpmdFedAvgSession.capability_gates() == {
+        "round_horizon": None,
+        "selection_gather": None,
+        "update_guard": None,
+    }
+    assert SpmdFedOBDSession.capability_gates() == {
+        "round_horizon": None,
+        "selection_gather": None,
+        "update_guard": None,
+    }
+    pp = SpmdPipelineSession.capability_gates()
+    assert pp["round_horizon"] is None
+    assert pp["selection_gather"] is None
+    assert "per-stage local" in pp["update_guard"]
+    smafd = SpmdSMAFDSession.capability_gates()
+    assert "builds its own round function" in smafd["round_horizon"]
+    assert "builds its own round program" in smafd["selection_gather"]
+    assert "builds its own round program" in smafd["update_guard"]
+
+
+# --------------------------------------------------------- CLI/allowlist
+def test_allowlist_loads_with_jaxlint_hygiene():
+    """Same loader, same audit rules as jaxlint: justification required,
+    duplicates rejected (tools/jaxlint/allowlist.py).  Keys must name a
+    real rule and a real subject (a matrix cell or a conf file) — the
+    cheap tier-1 half of stale detection; the full sweep (test.sh CLI)
+    fails on entries whose finding no longer fires."""
+    from tools.shardcheck import CELLS
+
+    cell_keys = {c.key for c in CELLS}
+    allow = load_allowlist(DEFAULT_ALLOWLIST)
+    for key, justification in allow.items():
+        assert key.count("::") == 2, key
+        assert justification.strip(), key
+        subject, layout, rule = key.split("::")
+        assert rule in RULES, key
+        assert (
+            f"{subject}::{layout}" in cell_keys
+            or subject.startswith("conf/")
+        ), f"allowlist subject references no known cell or conf: {key}"
+
+
+def test_cli_json_contract():
+    """``python -m tools.shardcheck --format json`` (narrowed to one
+    cell for the tier-1 budget) exits 0 and emits the machine-readable
+    summary bench.py consumes as ``shardcheck_findings``."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "tools.shardcheck",
+            "--session",
+            "fed_avg",
+            "--layout",
+            "client_axis",
+            "--format",
+            "json",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert sorted(payload["rules"]) == sorted(RULES)
+    assert payload["cells"] == ["fed_avg::client_axis"]
+    assert payload["conf_files"] > 0
+    assert payload["unaudited"] == 0
+    assert payload["stale_allowlist"] == []
+    assert payload["total_findings"] == payload["allowlisted"]
+    for row in payload["findings"]:
+        assert row["allowlisted"] is True
+        assert row["justification"].strip()

@@ -1,0 +1,25 @@
+"""NEGATIVE: canonical vocabulary axes, a file-declared custom mesh,
+and non-literal specs are all clean."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+SLOT_SPEC = P("clients")
+EXPERT_SPEC = P("ep", None, None)
+FSDP_SPEC = P(("clients", "model"))
+
+
+def ring_mesh(devices):
+    # a literal axis_names declaration extends this file's vocabulary
+    return Mesh(np.asarray(devices), axis_names=("ring",))
+
+
+RING_SPEC = P("ring")
+
+
+def reduce_over(axis):
+    def body(x):
+        return jax.lax.psum(x, axis_name=axis)  # non-literal: out of scope
+
+    return body

@@ -1,0 +1,7 @@
+"""POSITIVE: a collective's axis_name outside the mesh vocabulary."""
+
+import jax
+
+
+def reduce_votes(votes):
+    return jax.lax.psum(votes, axis_name="workers")  # no such mesh axis

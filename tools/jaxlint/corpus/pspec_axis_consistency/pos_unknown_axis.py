@@ -1,0 +1,8 @@
+"""POSITIVE: a PartitionSpec axis name outside the mesh vocabulary —
+the fabricated ``P("expert")``-on-a-client-mesh mistake (the ep axis is
+spelled "ep" everywhere a mesh is built)."""
+
+from jax.sharding import PartitionSpec as P
+
+#: a sharding table no mesh in this codebase can bind
+EXPERT_KERNEL_SPEC = P("expert", None, None)

@@ -1,0 +1,25 @@
+"""NEGATIVE: the three blessed shapes — constraint-wrapped, assigned
+then constrained, and an enclosing jit with an out_shardings pin."""
+
+import jax
+import jax.numpy as jnp
+
+SHARDING = object()  # stand-in for a NamedSharding
+
+
+def gather_wrapped(stack, sel_idx):
+    return jax.lax.with_sharding_constraint(
+        jnp.take(stack, sel_idx, axis=0), SHARDING
+    )
+
+
+def gather_assigned(stack, sel_idx):
+    cohort = jnp.take(stack, sel_idx, axis=0)
+    cohort = jax.lax.with_sharding_constraint(cohort, SHARDING)
+    return cohort
+
+
+split_sel = jax.jit(
+    lambda key, idx: jnp.take(jax.random.split(key, 8), idx, axis=0),
+    out_shardings=SHARDING,
+)

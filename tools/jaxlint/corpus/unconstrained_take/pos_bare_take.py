@@ -1,0 +1,10 @@
+"""POSITIVE: a device-side gather of the resident stack whose result
+never passes through a sharding constraint — GSPMD may re-replicate the
+cohort (the sp gather hazard)."""
+
+import jax.numpy as jnp
+
+
+def gather_cohort(stack, sel_idx):
+    cohort = jnp.take(stack, sel_idx, axis=0)
+    return cohort * 2.0

@@ -1,8 +1,10 @@
 """Rule registry: name -> Rule class, in documentation order."""
 
 from .host_sync import HostSyncInHotLoop
+from .pspec_axes import PSpecAxisConsistency
 from .retrace import RetraceHazard
 from .rng_split import RngSplitCountDiscipline
+from .unconstrained_take import UnconstrainedTake
 from .use_after_donate import UseAfterDonate
 from .zero_copy import ZeroCopyView
 
@@ -14,6 +16,8 @@ RULES = {
         RngSplitCountDiscipline,
         RetraceHazard,
         ZeroCopyView,
+        PSpecAxisConsistency,
+        UnconstrainedTake,
     )
 }
 
@@ -24,4 +28,6 @@ __all__ = [
     "RngSplitCountDiscipline",
     "RetraceHazard",
     "ZeroCopyView",
+    "PSpecAxisConsistency",
+    "UnconstrainedTake",
 ]

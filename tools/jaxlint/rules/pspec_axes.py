@@ -1,0 +1,100 @@
+"""pspec-axis-consistency: literal ``PartitionSpec`` axis names outside
+the mesh vocabulary in scope.
+
+The repo's mesh vocabulary is fixed by construction: ``make_mesh()``
+builds ``("clients", "model")`` and the whole-mesh sessions carve
+``("ep",)`` / ``("sp",)`` / ``("pp",)`` submeshes.  A literal axis name
+outside that set — ``P("expert")`` where the ep sessions spell the axis
+``"ep"`` — can never resolve against any mesh this codebase builds; at
+runtime it dies as a bare unbound-resource error deep in GSPMD at the
+first trace (or, worse, only when the one session using that table is
+exercised).  ``tools/shardcheck`` proves the same invariant at the
+lowering level for the instantiated matrix; this rule catches the typo
+in ANY file, including tables no session currently reads.
+
+A file can extend the vocabulary by declaring a mesh literally:
+``Mesh(..., axis_names=("ring",))`` adds ``"ring"`` for that file.
+Non-literal axis expressions (variables, ``*axes``) are out of scope.
+``axis_name=`` kwargs of collectives are checked against the same
+vocabulary.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Finding, Rule, dotted_name
+
+#: the mesh axis names this codebase can construct (mesh.py::make_mesh
+#: plus the whole-mesh session submeshes)
+DEFAULT_VOCAB = frozenset({"clients", "model", "ep", "sp", "pp"})
+
+_PSPEC_SUFFIXES = ("PartitionSpec",)
+_PSPEC_ALIASES = ("P", "PartitionSpec")
+_AXIS_KWARGS = ("axis_name",)
+
+
+def _literal_strings(node: ast.AST) -> list[str]:
+    """String literals inside a constant/tuple/list expression."""
+    out = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            out.extend(_literal_strings(elt))
+    return out
+
+
+def file_vocabulary(ctx: FileContext) -> frozenset[str]:
+    """DEFAULT_VOCAB plus every axis name the file declares literally
+    via an ``axis_names=`` kwarg (``Mesh(..., axis_names=("ring",))``)."""
+    extra: set[str] = set()
+    for call in ctx.calls():
+        for kw in call.keywords:
+            if kw.arg == "axis_names":
+                extra.update(_literal_strings(kw.value))
+    return DEFAULT_VOCAB | extra
+
+
+def _is_pspec_call(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if name in _PSPEC_ALIASES:
+        return True
+    return name.endswith(tuple("." + s for s in _PSPEC_SUFFIXES))
+
+
+class PSpecAxisConsistency(Rule):
+    name = "pspec-axis-consistency"
+    description = (
+        "literal PartitionSpec axis names (and collective axis_name"
+        " kwargs) outside the mesh vocabulary in scope — an unbound"
+        " axis dies as a bare GSPMD resource error at first trace"
+    )
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        vocab = file_vocabulary(ctx)
+        findings: list[Finding] = []
+        for call in ctx.calls():
+            names: list[str] = []
+            if _is_pspec_call(call):
+                for arg in call.args:
+                    names.extend(_literal_strings(arg))
+            for kw in call.keywords:
+                if kw.arg in _AXIS_KWARGS:
+                    names.extend(_literal_strings(kw.value))
+            unknown = sorted({n for n in names if n not in vocab})
+            if unknown:
+                findings.append(
+                    ctx.finding(
+                        self.name,
+                        call,
+                        "axis name(s)"
+                        f" {', '.join(repr(n) for n in unknown)} outside"
+                        " the mesh vocabulary"
+                        f" ({', '.join(sorted(vocab))}) — no mesh this"
+                        " codebase builds binds them; declare the mesh"
+                        " literally (axis_names=...) in this file if the"
+                        " axis is real",
+                    )
+                )
+        return findings

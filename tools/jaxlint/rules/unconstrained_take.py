@@ -1,0 +1,96 @@
+"""unconstrained-take: device-side ``jnp.take`` with no following
+sharding constraint — the sp gather hazard.
+
+The selection-aware gather's contract is a device-side ``jnp.take``
+along the slot axis of a SHARDED resident stack.  Without a constraint
+on the result, GSPMD is free to re-replicate the gathered cohort (it
+often does: the gather indices are replicated), silently undoing the
+layout the session stored — the sequence-parallel session's
+sequence-sharded data would be gathered onto every device.  The repo
+idiom is therefore ``with_sharding_constraint(jnp.take(...), s)`` (or
+an enclosing ``jax.jit(..., out_shardings=...)`` pinning the result).
+
+The rule flags ``jnp.take`` calls that are NOT (a) an argument of a
+``with_sharding_constraint`` call, (b) assigned to a name later passed
+to ``with_sharding_constraint`` in the same function, or (c) inside a
+callable jitted with an ``out_shardings`` pin.  Host-side ``np.take``
+is out of scope (no sharding to lose).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Finding, Rule, dotted_name, is_jit_call
+
+_TAKE_NAMES = ("jnp.take", "jax.numpy.take")
+_CONSTRAINT_SUFFIX = "with_sharding_constraint"
+
+
+def _has_out_shardings(call: ast.Call) -> bool:
+    return is_jit_call(call) and any(
+        kw.arg == "out_shardings" for kw in call.keywords
+    )
+
+
+class UnconstrainedTake(Rule):
+    name = "unconstrained-take"
+    description = (
+        "device-side jnp.take of a sharded leaf with no following"
+        " sharding constraint — GSPMD may re-replicate the gathered"
+        " stack (the sp gather hazard)"
+    )
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for call in ctx.calls():
+            if dotted_name(call.func) not in _TAKE_NAMES:
+                continue
+            if self._constrained(ctx, call):
+                continue
+            findings.append(
+                ctx.finding(
+                    self.name,
+                    call,
+                    "jnp.take result never passes through"
+                    " with_sharding_constraint (and no enclosing"
+                    " out_shardings pin) — GSPMD may re-replicate the"
+                    " gathered stack, undoing the stored layout (the sp"
+                    " gather hazard); constrain the result to the"
+                    " leaf's own stored sharding",
+                )
+            )
+        return findings
+
+    def _constrained(self, ctx: FileContext, call: ast.Call) -> bool:
+        # (a) syntactically inside a with_sharding_constraint call's
+        # arguments, or (c) inside a callable jitted with out_shardings
+        for anc in ctx.ancestors(call):
+            if isinstance(anc, ast.Call):
+                if dotted_name(anc.func).endswith(_CONSTRAINT_SUFFIX):
+                    return True
+                if _has_out_shardings(anc):
+                    return True
+        # (b) assigned to a name later fed to with_sharding_constraint
+        # in the same function
+        stmt = ctx.enclosing_statement(call)
+        func = ctx.enclosing_callable(call)
+        if not isinstance(stmt, ast.Assign) or func is None:
+            return False
+        targets = {
+            t.id for t in stmt.targets if isinstance(t, ast.Name)
+        }
+        if not targets:
+            return False
+        for other in ast.walk(func):
+            if (
+                isinstance(other, ast.Call)
+                and dotted_name(other.func).endswith(_CONSTRAINT_SUFFIX)
+            ):
+                for arg in ast.walk(other):
+                    if (
+                        isinstance(arg, ast.Name)
+                        and arg.id in targets
+                    ):
+                        return True
+        return False

@@ -1,0 +1,66 @@
+"""shardcheck: lowering-level static certification of the SPMD session
+matrix.
+
+jaxlint (``tools/jaxlint``) proves source-text invariants; this tool
+proves the *compiled contract*: it instantiates every registered
+session family × layout on tiny synthetic CPU meshes and, with
+``jax.eval_shape`` + ``jax.jit(...).lower()`` — no execution, no
+training — certifies four invariant classes per session:
+
+1. **mesh-axis-vocabulary** — every PartitionSpec axis name in scope
+   exists in its mesh;
+2. **donation-soundness** — donated carry input layouts equal the
+   compiled/pinned output layouts leaf-for-leaf (the PR 8 opt-carry
+   donation-aliasing class);
+3. **dispatch-budget** — one lowered module per horizon, and two rounds
+   with different selections hit the same jit cache entry;
+4. **conf-capability** — every ``conf/**/*.yaml`` fused-round knob is
+   validated against the session class's ``capability_gates``.
+
+Findings are keyed ``session::layout::rule`` against the audited
+allowlist ``tools/shardcheck/allowlist.txt`` (jaxlint's format: a
+written justification per entry, stale entries fail).  CLI::
+
+    python -m tools.shardcheck [--rule R] [--format json] [--fast]
+
+See ``docs/jax_hazards.md`` for the case studies and audit workflow.
+"""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from .checks import (  # noqa: E402
+    RULES,
+    Finding,
+    certify_session,
+    certify_specs,
+)
+from .conf_caps import (  # noqa: E402
+    validate_conf_file,
+    validate_conf_tree,
+    validate_config,
+)
+from .matrix import CELLS, build_session, certify_cell, select_cells  # noqa: E402
+
+DEFAULT_ALLOWLIST = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "allowlist.txt"
+)
+
+__all__ = [
+    "RULES",
+    "Finding",
+    "CELLS",
+    "DEFAULT_ALLOWLIST",
+    "build_session",
+    "certify_cell",
+    "certify_session",
+    "certify_specs",
+    "select_cells",
+    "validate_conf_file",
+    "validate_conf_tree",
+    "validate_config",
+]

@@ -1,0 +1,202 @@
+"""CLI: ``python -m tools.shardcheck [--rule R]... [--session S]...
+[--layout L]... [--fast] [--allowlist F] [--format text|json]``.
+
+Certifies the full session×layout×conf matrix by default (the ``test.sh``
+gate); ``--fast`` restricts to the tier-1 cell tier.  Exit status: 0
+clean (every finding allowlisted, no stale entries), 1 on un-audited
+findings or stale allowlist entries, 2 on usage errors — mirroring
+``tools.jaxlint``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _ensure_cpu_env() -> None:
+    """Tiny synthetic CPU meshes: force the virtual 8-device cpu host
+    (the tests/conftest.py stance) BEFORE the jax backend initializes.
+    No-op when a backend is already up (pytest imports us after its own
+    bootstrap)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # pragma: no cover — backend already initialized
+        pass
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from .checks import RULES
+    from .matrix import CELLS
+
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.shardcheck",
+        description="lowering-level static certification of the SPMD"
+        " session matrix (docs/jax_hazards.md)",
+    )
+    parser.add_argument(
+        "--rule",
+        action="append",
+        choices=sorted(RULES),
+        help="run only this rule (repeatable; default: all four)",
+    )
+    parser.add_argument(
+        "--session",
+        action="append",
+        choices=sorted({c.session for c in CELLS}),
+        help="certify only this session family (repeatable)",
+    )
+    parser.add_argument(
+        "--layout",
+        action="append",
+        choices=sorted({c.layout for c in CELLS}),
+        help="certify only this layout (repeatable)",
+    )
+    parser.add_argument(
+        "--fast",
+        action="store_true",
+        help="tier-1 cells only (skip the slow whole-mesh cells)",
+    )
+    parser.add_argument(
+        "--allowlist",
+        default=None,
+        help="audited allowlist file, or 'none' to disable"
+        " (default: tools/shardcheck/allowlist.txt)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="report format (default: text)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    return parser
+
+
+RULE_DESCRIPTIONS = {
+    "mesh-axis-vocabulary": "every PartitionSpec axis name declared,"
+    " pinned, or fed to a program exists in the mesh in scope",
+    "donation-soundness": "donated carry input layouts equal the"
+    " compiled/pinned output layouts leaf-for-leaf (the PR 8 opt-carry"
+    " donation-aliasing class)",
+    "dispatch-budget": "rounds with different selections share one jit"
+    " cache entry; fused horizons return [H]-stacked metrics",
+    "conf-capability": "every conf/**/*.yaml fused-round knob validated"
+    " against the session class's capability_gates",
+}
+
+
+def run(argv: list[str] | None = None) -> int:
+    _ensure_cpu_env()
+    from tools.jaxlint.allowlist import AllowlistError, load_allowlist
+
+    from . import DEFAULT_ALLOWLIST
+    from .checks import RULES
+    from .conf_caps import validate_conf_tree
+    from .matrix import certify_cell, select_cells
+
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for name in RULES:
+            print(f"{name}: {RULE_DESCRIPTIONS[name]}")
+        return 0
+    rule_names = args.rule or list(RULES)
+    filtered = bool(
+        args.rule or args.session or args.layout or args.fast
+    )
+    allow: dict[str, str] = {}
+    allowlist_path = args.allowlist or DEFAULT_ALLOWLIST
+    if allowlist_path != "none":
+        try:
+            allow = load_allowlist(allowlist_path)
+        except FileNotFoundError:
+            print(
+                f"shardcheck: allowlist not found: {allowlist_path}",
+                file=sys.stderr,
+            )
+            return 2
+        except AllowlistError as exc:
+            print(f"shardcheck: {exc}", file=sys.stderr)
+            return 2
+
+    findings = []
+    cells = select_cells(
+        sessions=args.session,
+        layouts=args.layout,
+        tiers=("fast",) if args.fast else None,
+    )
+    program_rules = [r for r in rule_names if r != "conf-capability"]
+    certified = []
+    for cell in cells:
+        if program_rules:
+            findings.extend(certify_cell(cell, rules=program_rules))
+        certified.append(cell.key)
+    conf_count = 0
+    if "conf-capability" in rule_names:
+        conf = validate_conf_tree()
+        from .conf_caps import conf_files
+
+        conf_count = len(conf_files())
+        findings.extend(conf)
+
+    found_keys = {f.key for f in findings}
+    unaudited = [f for f in findings if f.key not in allow]
+    # stale detection only makes sense on a full, unfiltered sweep — a
+    # narrowed run simply cannot see every audited site
+    stale: list[str] = []
+    if not filtered:
+        stale = sorted(set(allow) - found_keys)
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "rules": rule_names,
+                    "cells": certified,
+                    "conf_files": conf_count,
+                    "total_findings": len(findings),
+                    "allowlisted": len(findings) - len(unaudited),
+                    "unaudited": len(unaudited),
+                    "stale_allowlist": stale,
+                    "findings": [
+                        {
+                            **f.as_dict(),
+                            "allowlisted": f.key in allow,
+                            **(
+                                {"justification": allow[f.key]}
+                                if f.key in allow
+                                else {}
+                            ),
+                        }
+                        for f in findings
+                    ],
+                }
+            )
+        )
+    else:
+        for f in unaudited:
+            print(f"{f.key}: [{f.program}] {f.message}")
+        for key in stale:
+            print(f"stale allowlist entry (no longer found): {key}")
+        audited = len(findings) - len(unaudited)
+        print(
+            f"shardcheck: certified {len(certified)} session cell(s) +"
+            f" {conf_count} conf file(s): {len(findings)} finding(s)"
+            f" ({audited} audited, {len(unaudited)} un-audited,"
+            f" {len(stale)} stale allowlist entr(y/ies))"
+        )
+    return 1 if unaudited or stale else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

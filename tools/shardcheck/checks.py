@@ -1,0 +1,458 @@
+"""The three lowering-level invariant rules shardcheck proves per
+program, over the :class:`ProgramSpec`/:class:`DeclaredSpec` records the
+sessions expose pre-dispatch (``parallel/introspect.py``):
+
+* ``mesh-axis-vocabulary`` — every ``PartitionSpec`` axis name a session
+  declares, pins, or feeds a program exists in the mesh in scope (the
+  fabricated ``PartitionSpec("expert")``-on-a-client-mesh mistake), and
+  the program actually lowers under its ambient mesh;
+* ``donation-soundness`` — every donated carry's input layout equals the
+  layout the compiled program hands back for the output the run loop
+  feeds into that position next dispatch, leaf for leaf (the PR 8
+  opt-state-carry donation-aliasing size mismatch: carry enters
+  replicated, GSPMD's unpinned output comes back expert-sharded);
+* ``dispatch-budget`` — two rounds with different host-side selections
+  present identical abstract signatures (same jit cache entry — no
+  retrace as selections change), and a fused horizon returns
+  ``[H]``-stacked metrics (one module, one sync per horizon).
+
+Everything here is ``jax.eval_shape`` + ``jax.jit(...).lower()`` (and
+the lowering's AOT compile for the layout truth) — no execution, no
+training.  The fourth rule, ``conf-capability``, is host-only and lives
+in ``conf_caps.py``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+
+RULES = (
+    "mesh-axis-vocabulary",
+    "donation-soundness",
+    "dispatch-budget",
+    "conf-capability",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One certification failure.  ``key`` (``session::layout::rule``)
+    is the allowlist identity — program names and messages are reported
+    but never part of the key, mirroring jaxlint's convention."""
+
+    rule: str
+    session: str  #: method name, or conf relpath for conf-capability
+    layout: str  #: client_axis / ep / sp / pp (or the session class)
+    message: str
+    program: str = ""  #: ProgramSpec name, '' for non-program findings
+
+    @property
+    def key(self) -> str:
+        return f"{self.session}::{self.layout}::{self.rule}"
+
+    def as_dict(self) -> dict:
+        return {
+            "key": self.key,
+            "rule": self.rule,
+            "session": self.session,
+            "layout": self.layout,
+            "program": self.program,
+            "message": self.message,
+        }
+
+
+def _axes_of(pspec) -> list:
+    """Flat axis names of a PartitionSpec-like (entries may be None,
+    a name, or a tuple of names)."""
+    try:
+        entries = tuple(pspec)
+    except TypeError:
+        return []
+    axes = []
+    for entry in entries:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            axes.extend(entry)
+        else:
+            axes.append(entry)
+    return axes
+
+
+def _sharding_equivalent(inp, out, ndim: int) -> bool:
+    if inp is None or out is None:
+        # unpinned / uncommitted side: nothing declared to contradict
+        return True
+    try:
+        return inp.is_equivalent_to(out, ndim)
+    except Exception:  # pragma: no cover — exotic sharding types
+        return str(inp) == str(out)
+
+
+def _leaves_with_path(tree):
+    import jax
+
+    return jax.tree_util.tree_flatten_with_path(tree)[0]
+
+
+def _keystr(path) -> str:
+    import jax
+
+    return jax.tree_util.keystr(path) or "<leaf>"
+
+
+class _LoweredSpec:
+    """One spec's shared static artifacts: the eval_shape output tree
+    and (optionally) the AOT-compiled program — built once, consumed by
+    every rule.  A trace/lower failure is captured, not raised: the
+    rules turn it into a finding."""
+
+    def __init__(self, spec, compile_programs: bool = True):
+        import jax
+
+        self.spec = spec
+        self.out_shape = None
+        self.compiled = None
+        self.error: Exception | None = None
+        ctx = (
+            spec.mesh_context()
+            if spec.mesh_context is not None
+            else contextlib.nullcontext()
+        )
+        try:
+            with ctx:
+                self.out_shape = jax.eval_shape(spec.jitted, *spec.args)
+                if compile_programs:
+                    self.compiled = spec.jitted.lower(*spec.args).compile()
+        except Exception as exc:  # noqa: BLE001 — reported as a finding
+            self.error = exc
+
+
+def _check_vocabulary(subject, layout, specs, decls, findings) -> None:
+    rule = "mesh-axis-vocabulary"
+    for decl in decls or ():
+        axis_names = tuple(getattr(decl.mesh, "axis_names", ()) or ())
+        unknown = [a for a in _axes_of(decl.spec) if a not in axis_names]
+        if unknown:
+            findings.append(
+                Finding(
+                    rule,
+                    subject,
+                    layout,
+                    f"declared sharding {decl.label!r} uses axis name(s)"
+                    f" {unknown} absent from the mesh in scope"
+                    f" (axes: {list(axis_names)})",
+                )
+            )
+    for spec in specs or ():
+        mesh_axes = tuple(getattr(spec.mesh, "axis_names", ()) or ())
+        seen: set[tuple[str, str]] = set()
+        for label, tree in (("args", spec.args), ("out_pin", spec.out_pin)):
+            for path, leaf in _leaves_with_path(tree):
+                # args leaves are ShapeDtypeStructs (sharding attached);
+                # out_pin leaves ARE shardings
+                sharding = getattr(leaf, "sharding", None)
+                if sharding is None and hasattr(leaf, "mesh"):
+                    sharding = leaf
+                pspec = getattr(sharding, "spec", None)
+                if pspec is None:
+                    continue
+                used_mesh = getattr(sharding, "mesh", None)
+                used_axes = tuple(
+                    getattr(used_mesh, "axis_names", ()) or ()
+                )
+                bad = [a for a in _axes_of(pspec) if a not in used_axes]
+                foreign = [a for a in used_axes if a not in mesh_axes]
+                for problem, detail in (
+                    (bad, "axis name(s) absent from their own mesh"),
+                    (
+                        foreign,
+                        "mesh axes foreign to the program's session mesh",
+                    ),
+                ):
+                    if problem and (label, str(problem)) not in seen:
+                        seen.add((label, str(problem)))
+                        findings.append(
+                            Finding(
+                                rule,
+                                subject,
+                                layout,
+                                f"{spec.name}: {label}{_keystr(path)}"
+                                f" uses {detail}: {problem}"
+                                f" (program mesh axes: {list(mesh_axes)})",
+                                program=spec.name,
+                            )
+                        )
+
+
+def _check_donation(subject, layout, lowered, findings) -> None:
+    rule = "donation-soundness"
+    spec = lowered.spec
+    if not spec.donate_argnums:
+        return
+    # structural pin check: the session's declared out_shardings pin for
+    # each donated carry must equal the carry's INPUT layout leaf-for-leaf
+    if spec.out_pin is not None:
+        for argnum, path_fn in spec.carries:
+            try:
+                pin_sub = path_fn(spec.out_pin)
+            except Exception as exc:  # noqa: BLE001 — drifted accessor
+                # a carry accessor that no longer matches the pin tree is
+                # itself a certification failure, never a silent skip
+                findings.append(
+                    Finding(
+                        rule,
+                        subject,
+                        layout,
+                        f"{spec.name}: out_shardings pin accessor for"
+                        f" donated arg {argnum} failed ({exc}) — the"
+                        " carry correspondence drifted from the program",
+                        program=spec.name,
+                    )
+                )
+                continue
+            if pin_sub is None:
+                continue
+            arg_leaves = _leaves_with_path(spec.args[argnum])
+            pin_leaves = _leaves_with_path(pin_sub)
+            if len(pin_leaves) == 1 and hasattr(pin_leaves[0][1], "mesh"):
+                # a single Sharding is a PREFIX pytree: jax.jit
+                # broadcasts it over the whole output subtree
+                pin_leaves = pin_leaves * len(arg_leaves)
+            if len(arg_leaves) != len(pin_leaves):
+                findings.append(
+                    Finding(
+                        rule,
+                        subject,
+                        layout,
+                        f"{spec.name}: donated arg {argnum}'s pin tree"
+                        f" has {len(pin_leaves)} leaves vs"
+                        f" {len(arg_leaves)} input leaves",
+                        program=spec.name,
+                    )
+                )
+                continue
+            for (path, leaf), (_pp, pin) in zip(arg_leaves, pin_leaves):
+                inp = getattr(leaf, "sharding", None)
+                if not _sharding_equivalent(inp, pin, len(leaf.shape)):
+                    findings.append(
+                        Finding(
+                            rule,
+                            subject,
+                            layout,
+                            f"{spec.name}: donated carry leaf"
+                            f"{_keystr(path)} enters as {inp} but the"
+                            f" out_shardings pin says {pin} — the donated"
+                            " buffer cannot alias a differently-laid-out"
+                            " output (the PR 8 opt-carry class)",
+                            program=spec.name,
+                        )
+                    )
+    # compiled check: GSPMD's ACTUAL output layout for the fed-back carry
+    # must equal the donated input layout (catches the unpinned case)
+    if lowered.compiled is None:
+        return
+    try:
+        out_shardings = lowered.compiled.output_shardings
+    except Exception as exc:  # pragma: no cover — backend without AOT
+        findings.append(
+            Finding(
+                rule,
+                subject,
+                layout,
+                f"{spec.name}: compiled output shardings unavailable:"
+                f" {exc}",
+                program=spec.name,
+            )
+        )
+        return
+    for argnum, path_fn in spec.carries:
+        try:
+            out_sub = path_fn(out_shardings)
+        except Exception as exc:  # noqa: BLE001 — drifted accessor
+            findings.append(
+                Finding(
+                    rule,
+                    subject,
+                    layout,
+                    f"{spec.name}: carry accessor for donated arg"
+                    f" {argnum} failed on the compiled output shardings"
+                    f" ({exc}) — the carry correspondence drifted from"
+                    " the program",
+                    program=spec.name,
+                )
+            )
+            continue
+        arg_leaves = _leaves_with_path(spec.args[argnum])
+        out_leaves = _leaves_with_path(out_sub)
+        if len(arg_leaves) != len(out_leaves):
+            findings.append(
+                Finding(
+                    rule,
+                    subject,
+                    layout,
+                    f"{spec.name}: donated arg {argnum}'s carry output"
+                    f" has {len(out_leaves)} leaves vs"
+                    f" {len(arg_leaves)} inputs",
+                    program=spec.name,
+                )
+            )
+            continue
+        for (path, leaf), (_op, out) in zip(arg_leaves, out_leaves):
+            inp = getattr(leaf, "sharding", None)
+            if not _sharding_equivalent(inp, out, len(leaf.shape)):
+                findings.append(
+                    Finding(
+                        rule,
+                        subject,
+                        layout,
+                        f"{spec.name}: donated carry leaf{_keystr(path)}"
+                        f" enters laid out as {inp} but the COMPILED"
+                        f" program returns it as {out} — per-device"
+                        " buffer sizes differ, so round-over-round"
+                        " donation trips an aliasing size mismatch at"
+                        " runtime (the PR 8 opt-carry class); pin"
+                        " out_shardings to the stored layout",
+                        program=spec.name,
+                    )
+                )
+
+
+def _check_dispatch(subject, layout, lowered, findings) -> None:
+    import jax
+
+    rule = "dispatch-budget"
+    spec = lowered.spec
+    base_leaves = _leaves_with_path(spec.args)
+    base_def = jax.tree_util.tree_structure(spec.args)
+    for i, alt in enumerate(spec.alt_args):
+        if jax.tree_util.tree_structure(alt) != base_def:
+            findings.append(
+                Finding(
+                    rule,
+                    subject,
+                    layout,
+                    f"{spec.name}: probe {i + 1} (a later round's"
+                    " inputs) has a different tree structure — every"
+                    " dispatch compiles a fresh program",
+                    program=spec.name,
+                )
+            )
+            continue
+        for (path, a), (_pb, b) in zip(base_leaves, _leaves_with_path(alt)):
+            same = (
+                a.shape == b.shape
+                and a.dtype == b.dtype
+                and _sharding_equivalent(
+                    getattr(a, "sharding", None),
+                    getattr(b, "sharding", None),
+                    len(a.shape),
+                )
+            )
+            if not same:
+                findings.append(
+                    Finding(
+                        rule,
+                        subject,
+                        layout,
+                        f"{spec.name}: arg{_keystr(path)} changes"
+                        f" abstract value between rounds"
+                        f" ({a.shape}/{a.dtype} vs {b.shape}/{b.dtype})"
+                        " — two rounds with different selections must"
+                        " hit the SAME jit cache entry; a per-round"
+                        " retrace breaks the dispatch budget",
+                        program=spec.name,
+                    )
+                )
+    if spec.scanned_len and spec.stacked_out and lowered.out_shape is not None:
+        try:
+            stacked = spec.stacked_out(lowered.out_shape)
+        except Exception as exc:  # noqa: BLE001 — drifted accessor
+            findings.append(
+                Finding(
+                    rule,
+                    subject,
+                    layout,
+                    f"{spec.name}: stacked-output accessor failed"
+                    f" ({exc}) — the [H]-stacking invariant can no"
+                    " longer be checked; realign the accessor with the"
+                    " horizon program's output structure",
+                    program=spec.name,
+                )
+            )
+            return
+        for path, leaf in _leaves_with_path(stacked):
+            if not leaf.shape or leaf.shape[0] != spec.scanned_len:
+                findings.append(
+                    Finding(
+                        rule,
+                        subject,
+                        layout,
+                        f"{spec.name}: fused-horizon output"
+                        f"{_keystr(path)} is not stacked"
+                        f" [H={spec.scanned_len}, ...] (got"
+                        f" {leaf.shape}) — per-round metrics would need"
+                        " extra host syncs",
+                        program=spec.name,
+                    )
+                )
+
+
+def certify_specs(
+    subject: str,
+    layout: str,
+    specs,
+    decls=None,
+    rules=None,
+    compile_programs: bool = True,
+) -> list[Finding]:
+    """Run the selected program rules over one subject's specs/decls.
+    Trace/lower failures become ``mesh-axis-vocabulary`` findings (an
+    unbound axis name is the canonical way a program refuses to lower)."""
+    active = tuple(rules) if rules else RULES
+    findings: list[Finding] = []
+    if "mesh-axis-vocabulary" in active:
+        _check_vocabulary(subject, layout, specs, decls, findings)
+    need_lowered = {"mesh-axis-vocabulary", "donation-soundness", "dispatch-budget"} & set(active)
+    if not need_lowered:
+        return findings
+    for spec in specs or ():
+        lowered = _LoweredSpec(spec, compile_programs=compile_programs)
+        if lowered.error is not None:
+            findings.append(
+                Finding(
+                    "mesh-axis-vocabulary",
+                    subject,
+                    layout,
+                    f"{spec.name}: failed to lower under its mesh:"
+                    f" {type(lowered.error).__name__}: {lowered.error}",
+                    program=spec.name,
+                )
+            )
+            continue
+        if "donation-soundness" in active:
+            _check_donation(subject, layout, lowered, findings)
+        if "dispatch-budget" in active:
+            _check_dispatch(subject, layout, lowered, findings)
+    return findings
+
+
+def certify_session(
+    method: str,
+    layout: str,
+    session,
+    rules=None,
+    compile_programs: bool = True,
+) -> list[Finding]:
+    """Certify one instantiated session via its introspection hooks."""
+    specs = session.shardcheck_programs()
+    decls = session.shardcheck_shardings()
+    return certify_specs(
+        method,
+        layout,
+        specs,
+        decls,
+        rules=rules,
+        compile_programs=compile_programs,
+    )

@@ -1,0 +1,174 @@
+"""``conf-capability``: cross-validate every ``conf/**/*.yaml`` knob
+against the session gates it would hit at runtime.
+
+A YAML that sets ``round_horizon: 5`` on a Shapley/smafd session, or
+``fault_tolerance.update_guard: true`` on the pipeline layout, today
+fails at round 1 (or raises in session ``__init__``) with the session's
+honest reason.  This validator surfaces the SAME reason at lint time:
+it resolves the session class the config would construct
+(``training.resolve_spmd_session_class`` — resolution only, no
+datasets/devices) and checks the fused-round knobs against the class's
+``capability_gates()``.  Host-only and fast: safe to run over the whole
+conf tree in tier-1.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+from .checks import Finding
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+#: the knobs cross-validated against capability_gates
+GATED_KNOBS = ("round_horizon", "selection_gather", "update_guard")
+
+
+def _layout_label(config) -> str:
+    model_kwargs = dict(config.model_kwargs or {})
+    if int(model_kwargs.get("pipeline_stages", 0)) > 1:
+        return "pp"
+    if int(model_kwargs.get("expert_parallel", 0)):
+        return "ep"
+    if int(model_kwargs.get("sequence_parallel", 0)):
+        return "sp"
+    return "client_axis"
+
+
+def _gates_for(cls) -> dict[str, str | None]:
+    gates = getattr(cls, "capability_gates", None)
+    if gates is None:
+        reason = (
+            f"{cls.__name__} has no fused-round machinery"
+            " (capability_gates undeclared — the knob is ignored or"
+            " rejected at runtime)"
+        )
+        return {knob: reason for knob in GATED_KNOBS}
+    return gates()
+
+
+def validate_config(config, subject: str) -> list[Finding]:
+    """Findings for one loaded config (``subject`` keys them — the conf
+    relpath for YAML sweeps)."""
+    from distributed_learning_simulator_tpu.training import (
+        resolve_spmd_session_class,
+    )
+    from distributed_learning_simulator_tpu.util.faults import FaultPlan
+
+    rule = "conf-capability"
+    layout = _layout_label(config)
+    findings: list[Finding] = []
+
+    def flag(message: str) -> None:
+        findings.append(Finding(rule, subject, layout, message))
+
+    # fault_tolerance keys are validated even on the threaded path —
+    # FaultPlan.from_config is THE config-honesty gate for that dict
+    try:
+        plan = FaultPlan.from_config(config)
+    except Exception as exc:  # noqa: BLE001 — misconfigured YAML
+        flag(f"fault_tolerance rejected: {exc}")
+        plan = None
+    try:
+        cls = resolve_spmd_session_class(config)
+    except Exception as exc:  # noqa: BLE001 — invalid layout×method combo
+        flag(str(exc))
+        return findings
+    if cls is None:
+        return findings  # threaded executor: the fused knobs don't apply
+    gates = _gates_for(cls)
+    kwargs = dict(config.algorithm_kwargs or {})
+
+    horizon = int(kwargs.get("round_horizon", 1) or 1)
+    if horizon > 1 and gates.get("round_horizon"):
+        flag(
+            f"round_horizon={horizon} on {cls.__name__}:"
+            f" {gates['round_horizon']}"
+        )
+
+    selection = kwargs.get("random_client_number")
+    selection_active = (
+        selection is not None and int(selection) < config.worker_number
+    )
+    if kwargs.get("selection_gather"):
+        if gates.get("selection_gather"):
+            flag(
+                f"selection_gather on {cls.__name__}:"
+                f" {gates['selection_gather']} — the session falls back"
+                " to the dense O(population) path with a warning"
+            )
+        elif not selection_active:
+            flag(
+                "selection_gather requested under full participation"
+                " (no random_client_number below worker_number) —"
+                " nothing to skip; the session falls back to the dense"
+                " path with a warning"
+            )
+
+    if plan is not None and plan.update_guard and gates.get("update_guard"):
+        flag(
+            f"fault_tolerance.update_guard on {cls.__name__}:"
+            f" {gates['update_guard']} — session __init__ raises"
+        )
+
+    quorum = int(kwargs.get("min_client_quorum", 0) or 0)
+    if quorum:
+        if quorum > config.worker_number:
+            flag(
+                f"min_client_quorum={quorum} exceeds"
+                f" worker_number={config.worker_number} — no round can"
+                " ever meet quorum"
+            )
+        elif selection is not None and quorum > int(selection):
+            flag(
+                f"min_client_quorum={quorum} exceeds the per-round"
+                f" cohort (random_client_number={int(selection)}) — every"
+                " round aborts on quorum"
+            )
+    return findings
+
+
+def conf_files(conf_dir: str | None = None) -> list[str]:
+    from distributed_learning_simulator_tpu.config import CONF_DIR
+
+    conf_dir = conf_dir or CONF_DIR
+    return sorted(
+        p
+        for p in glob.glob(
+            os.path.join(conf_dir, "**", "*.yaml"), recursive=True
+        )
+        if os.path.basename(p) != "global.yaml"
+    )
+
+
+def validate_conf_file(path: str, conf_dir: str | None = None) -> list[Finding]:
+    from distributed_learning_simulator_tpu.config import (
+        CONF_DIR,
+        load_config_from_file,
+    )
+
+    conf_dir = conf_dir or CONF_DIR
+    subject = "conf/" + os.path.relpath(path, conf_dir).replace(os.sep, "/")
+    try:
+        config = load_config_from_file(path)
+    except Exception as exc:  # noqa: BLE001 — unloadable YAML
+        return [
+            Finding(
+                "conf-capability",
+                subject,
+                "unloadable",
+                f"conf failed to load: {exc}",
+            )
+        ]
+    return validate_config(config, subject)
+
+
+def validate_conf_tree(conf_dir: str | None = None) -> list[Finding]:
+    """The whole-tree sweep (incl. ``large_scale/``)."""
+    findings: list[Finding] = []
+    for path in conf_files(conf_dir):
+        findings.extend(validate_conf_file(path, conf_dir=conf_dir))
+    return findings

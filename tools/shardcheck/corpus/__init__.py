@@ -1,0 +1,16 @@
+"""Shardcheck regression corpus: lowering-level bug reconstructions.
+
+Each case module exposes ``build() -> (program_specs, declared_specs)``
+and a ``RULE`` naming the rule that must flag it.  The tier-1 test
+(``tests/test_shardcheck.py``) proves each case is DETECTED — these are
+the checker's reason to exist, mirroring ``tools/jaxlint/corpus``.
+"""
+
+from . import mesh_axis_vocabulary, pr8_opt_carry_layout
+
+CASES = {
+    "pr8_opt_carry_layout": pr8_opt_carry_layout,
+    "mesh_axis_vocabulary": mesh_axis_vocabulary,
+}
+
+__all__ = ["CASES"]

@@ -1,0 +1,31 @@
+"""Fabricated mesh-axis-vocabulary mistake: ``PartitionSpec("expert")``
+pinned for a session living on a ``("clients",)`` mesh.
+
+The bug shape: an axis name that exists in ANOTHER layout's vocabulary
+(the ep sessions' expert axis is ``"ep"``; models spell constraints
+with it) gets typed into a client-axis session's sharding table.  At
+runtime this crashes at the first trace with a bare unbound-resource
+error deep in GSPMD; ``mesh-axis-vocabulary`` reports it structurally,
+pre-trace, naming the declaration.  The tier-1 corpus test pins the
+detection.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_learning_simulator_tpu.parallel.introspect import (
+    DeclaredSpec,
+)
+
+RULE = "mesh-axis-vocabulary"
+
+
+def build():
+    devices = jax.devices()
+    mesh = Mesh(np.asarray(devices), axis_names=("clients",))
+    decls = [
+        DeclaredSpec("params[experts.w_in]", mesh, P("expert", None, None)),
+        DeclaredSpec("slot_spec", mesh, P("clients")),  # fine — control
+    ]
+    return [], decls

@@ -1,0 +1,66 @@
+"""Reconstruction of the PR 8 opt-state-carry donation-aliasing bug.
+
+The incident: the whole-mesh (ep/sp) FedOBD fused-horizon program
+DONATES its per-slot optimizer-state carry, which enters REPLICATED
+(``fresh_opt_states`` pins the input placement) — but the output pin
+was left to the compiler, and GSPMD propagated the surrounding expert
+sharding onto the returned carry.  Per-device buffer sizes then differ
+(full copy in, 1/E-shard out), so XLA's donation aliasing trips a
+runtime size mismatch on the SECOND horizon chunk — invisible to any
+AST pass, and to the first dispatch.  The fix pins the carry's
+out_shardings replicated (``SpmdFedOBDSession._opt_carry_out_sharding``).
+
+This module rebuilds the exact shape: a donated carry entering
+replicated through a program whose body re-shards it over the ``ep``
+axis with an UNPINNED output.  ``donation-soundness`` must flag it —
+the tier-1 corpus test pins that.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_learning_simulator_tpu.parallel.introspect import (
+    DeclaredSpec,
+    ProgramSpec,
+)
+
+RULE = "donation-soundness"
+
+
+def build():
+    devices = jax.devices()
+    assert len(devices) >= 2, "corpus case needs >=2 (virtual) devices"
+    mesh = Mesh(np.asarray(devices[:2]), axis_names=("ep",))
+    replicated = NamedSharding(mesh, P())
+    expert = NamedSharding(mesh, P("ep", None))
+
+    def horizon_body(opt_carry, grads):
+        # the round math constrains the carry into the expert layout
+        # (GSPMD then keeps it there for the UNPINNED output)
+        updated = jax.lax.with_sharding_constraint(
+            opt_carry["momentum"] + grads, expert
+        )
+        return {"momentum": updated}
+
+    jitted = jax.jit(horizon_body, donate_argnums=(0,))  # no out pin
+    carry = {
+        "momentum": jax.ShapeDtypeStruct(
+            (4, 8), jnp.float32, sharding=replicated
+        )
+    }
+    grads = jax.ShapeDtypeStruct((4, 8), jnp.float32, sharding=replicated)
+    specs = [
+        ProgramSpec(
+            name="obd_horizon[opt_carry]",
+            jitted=jitted,
+            args=(carry, grads),
+            donate_argnums=(0,),
+            mesh=mesh,
+            out_pin=None,  # the bug: compiler-chosen carry layout
+            carries=((0, lambda out: out),),
+        )
+    ]
+    decls = [DeclaredSpec("opt_carry", mesh, P())]
+    return specs, decls

@@ -1,0 +1,216 @@
+"""The certified session matrix: every registered session family
+(fed_avg / fed_paq / sign_SGD / FedOBD) × every layout (client-axis /
+ep / sp / pp), instantiated on tiny synthetic CPU meshes with the SAME
+wiring the simulator uses (``training._make_spmd_session``) so the
+certified programs ARE the dispatched programs, not hand-built twins.
+
+Instantiation places tiny synthetic datasets and traces ``eval_shape``
+templates — it never runs a round.  Cells are tiered: ``fast`` cells
+ride tier-1 (``tests/test_shardcheck.py``), ``slow`` cells run in the
+full CLI sweep (``test.sh`` gate, bench) and the slow-marked test.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import tempfile
+
+
+@dataclasses.dataclass(frozen=True)
+class Cell:
+    session: str  #: method family (fed_avg / fed_paq / sign_SGD / fed_obd)
+    layout: str  #: client_axis / ep / sp / pp
+    tier: str  #: "fast" (tier-1) or "slow" (full sweep only)
+
+    @property
+    def key(self) -> str:
+        return f"{self.session}::{self.layout}"
+
+
+#: canonical tiny whole-mesh shapes (2-device submeshes so the sweep
+#: runs on any >=2-device host; the test env forces 8 virtual cpu
+#: devices, matching tests/conftest.py)
+MOE_EP_MODEL_KWARGS = dict(
+    d_model=16,
+    nhead=2,
+    num_encoder_layer=2,  # the MoE factory places expert FFNs on odd layers
+    n_experts=2,
+    max_len=16,
+    expert_parallel=2,
+)
+LONGCONTEXT_SP_MODEL_KWARGS = dict(
+    d_model=16,
+    nhead=2,
+    num_encoder_layer=1,
+    max_len=32,
+    dropout_rate=0.0,
+    sequence_parallel=2,
+)
+PIPELINE_PP_MODEL_KWARGS = dict(
+    d_model=16,
+    nhead=2,
+    num_encoder_layer=2,
+    max_len=16,
+    pipeline_stages=2,
+)
+
+CELLS = (
+    Cell("fed_avg", "client_axis", "fast"),
+    Cell("fed_paq", "client_axis", "fast"),
+    Cell("sign_SGD", "client_axis", "fast"),
+    Cell("fed_obd", "client_axis", "fast"),
+    Cell("fed_avg", "ep", "fast"),
+    # the PR 8 donation-aliasing incident's own layout — tier-1
+    Cell("fed_obd", "ep", "fast"),
+    Cell("fed_avg", "sp", "slow"),
+    Cell("fed_obd", "sp", "slow"),
+    Cell("fed_avg", "pp", "slow"),
+)
+
+
+def _obd_extras(config) -> None:
+    config.algorithm_kwargs.setdefault("dropout_rate", 0.3)
+    config.algorithm_kwargs.setdefault("second_phase_epoch", 1)
+    config.endpoint_kwargs = {
+        "server": {"weight": 0.01},
+        "worker": {"weight": 0.01},
+    }
+
+
+def build_config(cell: Cell, save_dir: str | None = None):
+    """The cell's tiny config — one definition per layout, shared by the
+    CLI sweep and the tier-1 pins."""
+    from distributed_learning_simulator_tpu.config import (
+        DistributedTrainingConfig,
+    )
+
+    save_dir = save_dir or tempfile.mkdtemp(prefix="shardcheck_")
+    if cell.layout == "client_axis":
+        config = DistributedTrainingConfig(
+            dataset_name="MNIST",
+            model_name="LeNet5",
+            distributed_algorithm=cell.session,
+            optimizer_name="SGD",
+            worker_number=4,
+            batch_size=8,
+            round=8,
+            epoch=1,
+            learning_rate=0.05,
+            executor="spmd",
+            # partial participation: the gather path (the certified
+            # default at scale) builds alongside the dense twin
+            algorithm_kwargs={"random_client_number": 2},
+            dataset_kwargs={"train_size": 32, "val_size": 8, "test_size": 16},
+            save_dir=save_dir,
+        )
+    else:
+        model_name, model_kwargs, max_len = {
+            "ep": (
+                "MoETransformerClassificationModel",
+                MOE_EP_MODEL_KWARGS,
+                16,
+            ),
+            "sp": ("LongContextTransformer", LONGCONTEXT_SP_MODEL_KWARGS, 32),
+            "pp": (
+                "TransformerClassificationModel",
+                PIPELINE_PP_MODEL_KWARGS,
+                16,
+            ),
+        }[cell.layout]
+        config = DistributedTrainingConfig(
+            dataset_name="imdb",
+            model_name=model_name,
+            distributed_algorithm=cell.session,
+            optimizer_name="SGD",
+            worker_number=2,
+            batch_size=4,
+            round=8,
+            epoch=1,
+            learning_rate=0.05,
+            executor="spmd",
+            algorithm_kwargs={"random_client_number": 1},
+            model_kwargs=dict(model_kwargs),
+            dataset_kwargs={
+                "train_size": 16,
+                "val_size": 4,
+                "test_size": 8,
+                "max_len": max_len,
+            },
+            save_dir=save_dir,
+        )
+    if cell.session.startswith("fed_obd"):
+        _obd_extras(config)
+    config.load_config_and_process()
+    return config
+
+
+def build_session(cell: Cell, save_dir: str | None = None):
+    """Instantiate the cell's session through the REAL task wiring
+    (datasets, engine, mesh resolution) — placement and trace only, no
+    round is ever dispatched."""
+    from distributed_learning_simulator_tpu.training import (
+        _build_task,
+        _make_spmd_session,
+    )
+
+    config = build_config(cell, save_dir=save_dir)
+    ctx = _build_task(config)
+    return _make_spmd_session(ctx)
+
+
+def certify_cell(
+    cell: Cell,
+    rules=None,
+    compile_programs: bool = True,
+    save_dir: str | None = None,
+):
+    """Findings for one cell (empty = certified).  An empty program
+    inventory is itself a finding — a hook that silently stops
+    registering programs must never read as 'certified clean'.  The
+    cell's scratch save_dir is cleaned up unless the caller owns it."""
+    import shutil
+
+    from .checks import Finding, certify_specs
+
+    owned = save_dir is None
+    if owned:
+        save_dir = tempfile.mkdtemp(prefix="shardcheck_")
+    try:
+        session = build_session(cell, save_dir=save_dir)
+        specs = session.shardcheck_programs()
+        if not specs:
+            return [
+                Finding(
+                    "dispatch-budget",
+                    cell.session,
+                    cell.layout,
+                    "session registered ZERO pre-dispatch programs —"
+                    " the shardcheck_programs hook returned an empty"
+                    " inventory, so certification would be vacuous"
+                    " (did a refactor move the _jitted_* handles?)",
+                )
+            ]
+        return certify_specs(
+            cell.session,
+            cell.layout,
+            specs,
+            session.shardcheck_shardings(),
+            rules=rules,
+            compile_programs=compile_programs,
+        )
+    finally:
+        if owned:
+            shutil.rmtree(save_dir, ignore_errors=True)
+
+
+def select_cells(sessions=None, layouts=None, tiers=None):
+    out = []
+    for cell in CELLS:
+        if sessions and cell.session not in sessions:
+            continue
+        if layouts and cell.layout not in layouts:
+            continue
+        if tiers and cell.tier not in tiers:
+            continue
+        out.append(cell)
+    return out
