@@ -925,6 +925,83 @@ def measure_fault_tolerance() -> dict:
     return out
 
 
+# roundtrace telemetry A/B (PR 10): the recorder rides the existing run
+# loops (host-side spans/events only — zero new dispatches, zero new host
+# syncs), so a telemetry-on fused run must cost ~the same wall time as a
+# telemetry-off one.  Measures full session.run() loops on the fused
+# LeNet5/MNIST H=4 shape and reports telemetry_overhead_fraction =
+# on/off wall time − 1 (≈0 is the design goal) plus retrace_events — the
+# trace's own count of jit-cache growth past first compile (0 means the
+# dispatch-budget invariant held at runtime).
+TEL_WORKERS = 4
+TEL_ROUNDS = 8
+TEL_HORIZON = 4
+TEL_BATCH = 16
+
+
+def measure_telemetry() -> dict:
+    from distributed_learning_simulator_tpu.parallel.spmd import (
+        SpmdFedAvgSession,
+    )
+    from distributed_learning_simulator_tpu.training import _build_task
+    from tools.tracedump import load_trace, summarize
+
+    out: dict = {
+        "model": "LeNet5/MNIST",
+        "workers": TEL_WORKERS,
+        "rounds": TEL_ROUNDS,
+        "horizon": TEL_HORIZON,
+    }
+    trace_path = None
+    for arm in ("off", "on"):
+        config = make_config(
+            "spmd",
+            TEL_WORKERS,
+            TEL_WORKERS * TEL_BATCH,
+            model_name="LeNet5",
+            batch_size=TEL_BATCH,
+            tag=f"telemetry_{arm}",
+            dataset_name="MNIST",
+            rounds=TEL_ROUNDS,
+            use_amp=False,  # the canonical LeNet5/MNIST config is fp32
+            algorithm_kwargs={"round_horizon": TEL_HORIZON},
+            telemetry={"enabled": arm == "on"},
+        )
+        if arm == "on":
+            trace_path = os.path.join(config.save_dir, "server", "trace.jsonl")
+            if os.path.isfile(trace_path):
+                os.remove(trace_path)  # fresh trace per bench invocation
+        ctx = _build_task(config)
+        session = SpmdFedAvgSession(
+            ctx.config,
+            ctx.dataset_collection,
+            ctx.model_ctx,
+            ctx.engine,
+            ctx.practitioners,
+        )
+        session.run()  # warmup: compiles the horizon program
+        session._stat.clear()
+        session.reset_dispatch_stats()
+        start = time.monotonic()
+        session.run()
+        elapsed = time.monotonic() - start
+        out[arm] = {
+            "rounds_per_sec": round(TEL_ROUNDS / elapsed, 4),
+            "seconds_per_round": round(elapsed / TEL_ROUNDS, 6),
+            "dispatches_per_round": round(session.dispatches_per_round, 4),
+        }
+    if out["off"]["seconds_per_round"] > 0:
+        out["telemetry_overhead_fraction"] = round(
+            out["on"]["seconds_per_round"] / out["off"]["seconds_per_round"]
+            - 1.0,
+            4,
+        )
+    summary = summarize(load_trace(trace_path))
+    out["retrace_events"] = summary["budget"]["retrace_events"]
+    out["trace_records"] = summary["records"]
+    return out
+
+
 def _tool_total_findings(module: str, timeout: float) -> int:
     """``python -m <module> --format json`` -> ``total_findings``.  A
     dirty exit (un-audited findings) still yields the count; only a
@@ -1025,6 +1102,15 @@ def main() -> None:
     # the -1/absent-never contract: the top-level field always prints; -1
     # means the measurement failed (same convention as lint_findings)
     dropout_overhead = fault_tolerance.get("dropout_overhead_fraction", -1.0)
+    # roundtrace telemetry A/B: telemetry-on vs -off wall time on the
+    # fused H=4 shape, plus the trace's own retrace count (0 = the
+    # dispatch-budget invariant held at runtime)
+    try:
+        telemetry = measure_telemetry()
+    except Exception as exc:
+        telemetry = {"error": str(exc)[:200]}
+    telemetry_overhead = telemetry.get("telemetry_overhead_fraction", -1.0)
+    retrace_events = telemetry.get("retrace_events", -1)
     # analyzer health: total jaxlint findings over the package (every one
     # audited in tools/jaxlint/allowlist.txt — un-audited findings fail
     # tier-1, so this counts the standing audited-hazard surface)
@@ -1142,6 +1228,12 @@ def main() -> None:
                 # missing)
                 "dropout_overhead_fraction": dropout_overhead,
                 "fault_tolerance": fault_tolerance,
+                # roundtrace: telemetry-on must cost ~nothing (fraction ≈
+                # 0; -1 = the A/B failed, the fields never go missing)
+                # and the smoke trace must observe zero retraces
+                "telemetry_overhead_fraction": telemetry_overhead,
+                "retrace_events": retrace_events,
+                "telemetry": telemetry,
                 "lint_findings": lint_findings,
                 "shardcheck_findings": shardcheck_findings,
                 "canonical": canonical,

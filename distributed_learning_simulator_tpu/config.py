@@ -96,6 +96,15 @@ class DistributedTrainingConfig:
     # with exponential backoff before raising a diagnostic naming the
     # unreachable coordinator (parallel/mesh.py::initialize_multihost)
     multihost_init_retries: int = 0
+    # roundtrace telemetry (util/telemetry.py::TraceRecorder): structured
+    # span/event JSONL under <save_dir>/server/trace.jsonl — round/horizon/
+    # eval spans, per-dispatch + per-host-sync events, jit-cache `compile`
+    # events, fault events, optional per-round jax.profiler windows
+    # (`profile_rounds: [a, b]`).  Empty/`enabled: false` = bit-exact
+    # no-op (no file, no record fields, zero dispatches either way).
+    # Unknown keys raise.  Read with `python -m tools.tracedump`; see
+    # docs/observability.md.
+    telemetry: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def load_config_and_process(self, overrides: dict[str, Any] | None = None) -> None:
         """Derive ``save_dir``/``log_file`` the way the reference does

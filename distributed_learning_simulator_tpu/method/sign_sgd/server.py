@@ -138,13 +138,13 @@ class GradientServer(Server):
 
     def _server_exit(self) -> None:
         if self._final_params is not None:
-            import json
             import os
+
+            from ...util.checkpoint import atomic_json_dump
 
             metric = self.get_metric(self._final_params)
             self._stat[1] = {f"test_{k}": v for k, v in metric.items()}
-            with open(
-                os.path.join(self.save_dir, "round_record.json"), "wt", encoding="utf8"
-            ) as f:
-                json.dump(self._stat, f)
+            atomic_json_dump(
+                os.path.join(self.save_dir, "round_record.json"), self._stat
+            )
         self._algorithm.exit()

@@ -392,7 +392,69 @@ def scan_weighted_clients(
     return new_global, metrics
 
 
-class SpmdFedAvgSession:
+class TraceCounterMixin:
+    """Shared roundtrace surface for the SPMD sessions (requires
+    ``self._trace``, ``self._fault_plan``, ``self._update_guard``,
+    ``self.config``): the legacy counters, DERIVED from the trace
+    recorder — the run loops emit ``dispatch``/``host_sync`` events at
+    exactly the old increment sites, so the values stay pinned identical
+    by the test_round_horizon / test_selection_gather dispatch-budget
+    tests — plus the per-round ``fault`` event helper."""
+
+    @property
+    def dispatch_count(self) -> int:
+        return self._trace.counters.get("dispatch", 0)
+
+    @property
+    def host_sync_count(self) -> int:
+        return self._trace.counters.get("host_sync", 0)
+
+    @property
+    def rounds_run(self) -> int:
+        return self._trace.counters.get("rounds", 0)
+
+    def reset_dispatch_stats(self) -> None:
+        self._trace.reset_counters("dispatch", "host_sync", "rounds")
+
+    def _trace_fault_event(
+        self, round_number: int, rejected, selected=None
+    ) -> None:
+        """One ``fault`` trace event per faulted-machinery round: the
+        guard's reject count plus how many SELECTED clients the round's
+        availability mask dropped (the PR 7 weight-row masking) — every
+        value is host state the loop already owns, fetched at the round's
+        existing sync point, so the event costs nothing extra.
+        ``selected`` overrides the cohort (OBD phase 2 participates
+        fully while its stat keys keep advancing the selection stream)."""
+        plan = self._fault_plan
+        if not self._trace.enabled or plan is None:
+            return
+        if not (plan.injection_active or self._update_guard):
+            return
+        dropped = 0
+        if plan.injection_active:
+            if selected is None:
+                from ..utils.selection import select_workers
+
+                selected = select_workers(
+                    self.config.seed,
+                    round_number,
+                    self.config.worker_number,
+                    self.config.algorithm_kwargs.get("random_client_number"),
+                )
+            dropped = len(
+                plan.dropped_clients(round_number, self.config.worker_number)
+                & set(selected)
+            )
+        self._trace.event(
+            "fault",
+            round=round_number,
+            rejected_updates=int(rejected),
+            dropped_clients=dropped,
+        )
+
+
+class SpmdFedAvgSession(TraceCounterMixin):
     """FedAvg-family rounds as single SPMD programs.
 
     Supported method semantics: fed_avg (full/delta uploads are equivalent
@@ -586,15 +648,22 @@ class SpmdFedAvgSession:
         #: best_global_model.npz promotion of later boundary rounds
         self._best_ckpt_acc = 0.0
         self._eval_batches = None  # device-resident, built on first eval
-        # dispatch-budget instrumentation (bench.py): jitted program
-        # launches and blocking device→host fetches issued by the run loop
-        self.dispatch_count = 0
-        self.host_sync_count = 0
-        self.rounds_run = 0
+        # roundtrace telemetry (util/telemetry.py): the recorder's integer
+        # counters back the legacy dispatch_count/host_sync_count/
+        # rounds_run attributes (bench.py dispatch budgets); with
+        # config.telemetry.enabled it additionally streams span/event
+        # records to <save_dir>/server/trace.jsonl — zero new dispatches,
+        # zero new host syncs, bit-exact trajectories either way
+        from ..util.telemetry import TraceRecorder
+
+        self._trace = TraceRecorder.from_config(config)
         from ..util.checkpoint import AsyncCheckpointWriter
 
         self._ckpt = AsyncCheckpointWriter()
         self._ckpt.register_finalizer("round_record", self._flush_record)
+        # the trace tail flushes through the same exit-finalizer hook the
+        # record flusher rides (error path included)
+        self._ckpt.register_finalizer("roundtrace", self._trace.close)
         self._ckpt_queued_round: int | None = None
 
         self._data, self._dataset_sizes, self.n_batches = stack_client_data(
@@ -1124,19 +1193,38 @@ class SpmdFedAvgSession:
                 gather_round_program, donate_argnums=(0,), **jit_kwargs
             )
 
+        # the dispatch tail rides TraceRecorder.dispatch (roundtrace): a
+        # `compile` event fires whenever the program's jit cache grew —
+        # the dispatch-budget invariant (shardcheck's static
+        # `dispatch-budget` rule) observed at runtime.  One int compare
+        # per dispatch, enabled-gated, no device touch.
         def fn(global_params, weights, rngs, sel_idx=None):
             with self._round_mesh_context():
                 if sel_idx is not None:
-                    return self._jitted_gather_round_fn(
+                    return self._trace.dispatch(
+                        "round[gather]",
+                        self._jitted_gather_round_fn,
+                        (
+                            global_params,
+                            weights,
+                            rngs,
+                            sel_idx,
+                            self._data,
+                            self._val_data or {},
+                        ),
+                        sig_args=(weights, rngs, sel_idx),
+                    )
+                return self._trace.dispatch(
+                    "round[dense]",
+                    jitted,
+                    (
                         global_params,
                         weights,
                         rngs,
-                        sel_idx,
                         self._data,
                         self._val_data or {},
-                    )
-                return jitted(
-                    global_params, weights, rngs, self._data, self._val_data or {}
+                    ),
+                    sig_args=(weights, rngs),
                 )
 
         return fn
@@ -1207,14 +1295,19 @@ class SpmdFedAvgSession:
 
         def fn(global_params, rng, weight_rows, idx_rows=None):
             with self._round_mesh_context():
-                return jitted(
-                    global_params,
-                    rng,
-                    weight_rows,
-                    idx_rows,
-                    self._data,
-                    self._val_data or {},
-                    self._ensure_eval_batches(),
+                return self._trace.dispatch(
+                    f"horizon[h={horizon}]",
+                    jitted,
+                    (
+                        global_params,
+                        rng,
+                        weight_rows,
+                        idx_rows,
+                        self._data,
+                        self._val_data or {},
+                        self._ensure_eval_batches(),
+                    ),
+                    sig_args=(weight_rows, idx_rows),
                 )
 
         fn._jitted = jitted
@@ -1541,6 +1634,9 @@ class SpmdFedAvgSession:
                 # this good — only a better checkpointed round re-promotes
                 self._best_ckpt_acc = self._max_acc
                 get_logger().info("resumed from %s round %d", resume_dir, last)
+                self._trace.event(
+                    "resume", round=last + 1, source=str(resume_dir)
+                )
                 return self._place_params(params), last + 1
         init_path = config.algorithm_kwargs.get("global_model_path")
         if init_path:
@@ -1590,10 +1686,13 @@ class SpmdFedAvgSession:
                 # The chain stays device-resident (no host bounce).  On the
                 # selection-gather path the same streams are folded for the
                 # selected ids only.
+                self._trace.maybe_profile_start(round_number)
                 host_weights, weights, client_rngs, sel_idx = (
                     self._prepare_round_inputs(round_number, round_rng)
                 )
-                self.dispatch_count += 1
+                self._trace.event(
+                    "dispatch", program="fold_rngs", round=round_number
+                )
                 # old global_params are donated into the round program —
                 # any pending background fetch of them must finish first
                 self._ckpt.barrier()
@@ -1606,7 +1705,9 @@ class SpmdFedAvgSession:
                     phase="round",
                     round_number=round_number,
                 )
-                self.dispatch_count += 1
+                self._trace.event(
+                    "dispatch", program="round", round=round_number
+                )
                 # queue the round checkpoint NOW so its device→host fetch
                 # and disk write overlap the test-set evaluation below
                 if self._should_checkpoint(round_number):
@@ -1616,14 +1717,18 @@ class SpmdFedAvgSession:
                     )
                     self._ckpt_queued_round = round_number
                     self._last_ckpt_round = round_number
-                metric = self._watchdog.call(
-                    lambda gp=global_params: self._evaluate(gp),
-                    phase="eval",
-                    round_number=round_number,
+                    self._trace.event("checkpoint", round=round_number)
+                with self._trace.span("eval", round=round_number):
+                    metric = self._watchdog.call(
+                        lambda gp=global_params: self._evaluate(gp),
+                        phase="eval",
+                        round_number=round_number,
+                    )
+                self._trace.event(
+                    "dispatch", program="eval", round=round_number
                 )
-                self.dispatch_count += 1
-                self.host_sync_count += 1
-                self.rounds_run += 1
+                self._trace.event("host_sync", round=round_number)
+                self._trace.count("rounds")
                 # same stat surface as the threaded server: analytic wire
                 # cost (what the aggregation consumed over ICI, priced at
                 # the reference's message sizes) + round wall time
@@ -1645,6 +1750,7 @@ class SpmdFedAvgSession:
                         np.asarray(train_metrics["rejected_updates"])
                     )
                     extra["rejected_updates"] = rejected
+                self._trace_fault_event(round_number, rejected)
                 self._record(
                     round_number, metric, global_params, save_dir, extra=extra
                 )
@@ -1654,6 +1760,7 @@ class SpmdFedAvgSession:
                     round_number, (host_weights != 0).sum(), rejected
                 )
                 self._maybe_kill(round_number)
+                self._trace.maybe_profile_stop(round_number)
         return {"performance": self._stat}
 
     def _run_horizon(self) -> dict:
@@ -1695,6 +1802,7 @@ class SpmdFedAvgSession:
                     fn = self._horizon_fns[h] = self._build_horizon_fn(h)
                 start = _time.monotonic()
                 boundary = round_number + h - 1
+                self._trace.maybe_profile_start(round_number, boundary)
                 host_weights, weight_rows, idx_rows = (
                     self._horizon_selection_rows(round_number, h)
                 )
@@ -1708,7 +1816,12 @@ class SpmdFedAvgSession:
                     phase="round",
                     round_number=boundary,
                 )
-                self.dispatch_count += 1
+                self._trace.event(
+                    "dispatch",
+                    program=f"horizon[h={h}]",
+                    round=boundary,
+                    rounds=h,
+                )
                 # queue the boundary checkpoint NOW: its device→host fetch
                 # overlaps the stacked metric fetch below
                 if self._should_checkpoint(boundary):
@@ -1718,6 +1831,7 @@ class SpmdFedAvgSession:
                     )
                     self._ckpt_queued_round = boundary
                     self._last_ckpt_round = boundary
+                    self._trace.event("checkpoint", round=boundary)
                 # ONE host sync per horizon: the stacked eval metrics
                 per_round = stacked_round_metrics(outs[1])
                 confusion = np.asarray(outs[2]) if len(outs) > 2 else None
@@ -1729,8 +1843,15 @@ class SpmdFedAvgSession:
                     if self._update_guard
                     else None
                 )
-                self.host_sync_count += 1
+                self._trace.event("host_sync", round=boundary)
                 chunk_seconds = _time.monotonic() - start
+                self._trace.span_record(
+                    "horizon",
+                    chunk_seconds,
+                    first_round=round_number,
+                    last_round=boundary,
+                    rounds=h,
+                )
                 for i in range(h):
                     r = round_number + i
                     metric = per_round[i]
@@ -1744,6 +1865,10 @@ class SpmdFedAvgSession:
                     }
                     if rejected_rows is not None:
                         extra["rejected_updates"] = int(rejected_rows[i])
+                    self._trace_fault_event(
+                        r,
+                        rejected_rows[i] if rejected_rows is not None else 0,
+                    )
                     self._note_round(r, metric, save_dir, extra=extra)
                     if rejected_rows is not None:
                         self._post_guard_quorum(
@@ -1765,12 +1890,13 @@ class SpmdFedAvgSession:
                         self._ckpt.copy_last_to(
                             os.path.join(save_dir, "best_global_model.npz")
                         )
-                self.rounds_run += h
+                self._trace.count("rounds", h)
                 # a kill scheduled anywhere in the chunk fires at the
                 # horizon boundary (records + the boundary checkpoint are
                 # durable; a mid-horizon kill round simply resumes from an
                 # earlier boundary and re-trains the tail)
                 self._maybe_kill(round_number, boundary)
+                self._trace.maybe_profile_stop(boundary)
                 round_number += h
         return {"performance": self._stat}
 
@@ -1781,11 +1907,6 @@ class SpmdFedAvgSession:
         if round_number >= self.config.round:
             return True
         return round_number - self._last_ckpt_round >= self._checkpoint_every
-
-    def reset_dispatch_stats(self) -> None:
-        self.dispatch_count = 0
-        self.host_sync_count = 0
-        self.rounds_run = 0
 
     @property
     def dispatches_per_round(self) -> float:
@@ -1831,6 +1952,28 @@ class SpmdFedAvgSession:
         round_stat = {f"test_{k}": v for k, v in metric.items()}
         if extra:
             round_stat.update(extra)
+        if self._trace.enabled:
+            # one `round` span per recorded round on EVERY run path (the
+            # single funnel both loops and the OBD driver flow through);
+            # the record row cross-links the span's JSONL line offset
+            span_fields = {
+                "round": round_number,
+                "accuracy": metric.get("accuracy"),
+                "loss": metric.get("loss"),
+            }
+            for key in (
+                "received_mb",
+                "sent_mb",
+                "rejected_updates",
+                "phase",
+            ):
+                if extra and key in extra:
+                    span_fields[key] = extra[key]
+            round_stat["trace_offset"] = self._trace.span_record(
+                "round",
+                (extra or {}).get("round_seconds", 0.0),
+                **span_fields,
+            )
         self._stat[round_number] = round_stat
         get_logger().info(
             "round: %d, test accuracy %.4f loss %.4f (spmd)",
@@ -1849,6 +1992,11 @@ class SpmdFedAvgSession:
     def _flush_record(self) -> None:
         if not self._record_dirty or self._record_path is None:
             return
+        # rows cross-link trace spans by line offset (trace_offset) and a
+        # resumed recorder renumbers from the durable line count — land
+        # the referenced lines BEFORE the rows so a hard kill between the
+        # two writes can't leave rows pointing at a future session's lines
+        self._trace.flush()
         atomic_json_dump(self._record_path, self._stat)
         self._record_dirty = False
 
@@ -1901,7 +2049,7 @@ class SpmdFedAvgSession:
         return self._stat
 
 
-class SpmdSignSGDSession:
+class SpmdSignSGDSession(TraceCounterMixin):
     """The whole sign-SGD run as ONE SPMD program.
 
     The reference's sign-SGD substrate exchanges a gradient through pipes
@@ -1933,6 +2081,12 @@ class SpmdSignSGDSession:
         self._watchdog = DeadlineWatchdog.from_config(config, self.mesh)
         self.n_slots = client_slots(config.worker_number, self.mesh)
         self._stat: dict[int, dict] = {}
+        # roundtrace telemetry (util/telemetry.py) — same contract as
+        # SpmdFedAvgSession: counters always on, span/event records only
+        # under config.telemetry.enabled, zero new dispatches/syncs
+        from ..util.telemetry import TraceRecorder
+
+        self._trace = TraceRecorder.from_config(config)
         # round-horizon fusion, same contract as SpmdFedAvgSession: scan H
         # rounds (each already a whole-run-of-steps program) per dispatch,
         # evaluating in-program, fetching stacked metrics once per horizon
@@ -2144,10 +2298,18 @@ class SpmdSignSGDSession:
 
         def fn(params, weights, rngs, sel_idx=None):
             if sel_idx is not None:
-                return self._jitted_gather_run_fn(
-                    params, weights, rngs, sel_idx, self._data
+                return self._trace.dispatch(
+                    "run[gather]",
+                    self._jitted_gather_run_fn,
+                    (params, weights, rngs, sel_idx, self._data),
+                    sig_args=(weights, rngs, sel_idx),
                 )
-            return jitted(params, weights, rngs, self._data)
+            return self._trace.dispatch(
+                "run[dense]",
+                jitted,
+                (params, weights, rngs, self._data),
+                sig_args=(weights, rngs),
+            )
 
         return fn
 
@@ -2199,8 +2361,11 @@ class SpmdSignSGDSession:
         jitted = jax.jit(horizon_program, donate_argnums=(0,))
 
         def fn(params, rng_rows, weights, eval_batches, idx_rows=None):
-            return jitted(
-                params, rng_rows, weights, idx_rows, self._data, eval_batches
+            return self._trace.dispatch(
+                f"horizon[h={horizon}]",
+                jitted,
+                (params, rng_rows, weights, idx_rows, self._data, eval_batches),
+                sig_args=(rng_rows, idx_rows),
             )
 
         fn._jitted = jitted
@@ -2428,7 +2593,9 @@ class SpmdSignSGDSession:
         )
         return specs
 
-    def _note_round(self, round_number: int, metric, epoch_metrics) -> None:
+    def _note_round(
+        self, round_number: int, metric, epoch_metrics, round_seconds=0.0
+    ) -> None:
         """One round's stat row (identical surface on the per-round and
         horizon-fused paths: test metrics + per-epoch train curves)."""
         count = np.maximum(np.asarray(epoch_metrics["count"]), 1.0)
@@ -2451,6 +2618,18 @@ class SpmdSignSGDSession:
             # summed over the round's steps
             row["rejected_updates"] = float(
                 np.asarray(epoch_metrics["rejected_updates"]).sum()
+            )
+        self._trace_fault_event(round_number, row.get("rejected_updates", 0))
+        if self._trace.enabled:
+            span_fields = {
+                "round": round_number,
+                "accuracy": metric["accuracy"],
+                "loss": metric["loss"],
+            }
+            if "rejected_updates" in row:
+                span_fields["rejected_updates"] = row["rejected_updates"]
+            row["trace_offset"] = self._trace.span_record(
+                "round", round_seconds, **span_fields
             )
         self._stat[round_number] = row
         get_logger().info(
@@ -2486,10 +2665,14 @@ class SpmdSignSGDSession:
     def run(self) -> dict:
         if self.round_horizon > 1:
             return self._run_horizon()
+        import time as _time
+
         config = self.config
         params, weights, batches, save_dir = self._run_setup()
         best_acc = -1.0
         for round_number in range(1, config.round + 1):
+            round_start = _time.monotonic()
+            self._trace.maybe_profile_start(round_number)
             # same per-round streams on every path: split(PRNGKey(seed +
             # round), n_slots) indexed by worker id — the gather path takes
             # the selected rows of the identical host split
@@ -2520,6 +2703,8 @@ class SpmdSignSGDSession:
                 phase="round",
                 round_number=round_number,
             )
+            self._trace.event("dispatch", program="run", round=round_number)
+
             def guarded_eval(p=params):
                 metric = summarize_metrics(self.engine.evaluate(p, batches))
                 metric.update(
@@ -2527,13 +2712,30 @@ class SpmdSignSGDSession:
                 )
                 return metric
 
-            metric = self._watchdog.call(
-                guarded_eval, phase="eval", round_number=round_number
+            with self._trace.span("eval", round=round_number):
+                metric = self._watchdog.call(
+                    guarded_eval, phase="eval", round_number=round_number
+                )
+            self._trace.event("dispatch", program="eval", round=round_number)
+            self._trace.event("host_sync", round=round_number)
+            self._trace.count("rounds")
+            self._note_round(
+                round_number,
+                metric,
+                epoch_metrics,
+                round_seconds=_time.monotonic() - round_start,
             )
-            self._note_round(round_number, metric, epoch_metrics)
+            # this session has no AsyncCheckpointWriter exit finalizer to
+            # flush the trace tail on an abort — land each round's
+            # records with the (already per-round, synchronous) record
+            # write so a mid-run exception loses at most one round, and
+            # land them FIRST so durable rows never cross-link
+            # trace_offsets a resumed recorder would renumber
+            self._trace.flush()
             atomic_json_dump(
                 os.path.join(save_dir, "round_record.json"), self._stat
             )
+            self._trace.maybe_profile_stop(round_number)
             if metric["accuracy"] > best_acc:
                 best_acc = metric["accuracy"]
                 np.savez(
@@ -2546,6 +2748,7 @@ class SpmdSignSGDSession:
             # lands so the chaos suite can observe completed rounds
             if self._fault_plan is not None:
                 self._fault_plan.maybe_kill(round_number)
+        self._trace.close()
         return {"performance": self._stat}
 
     def _run_horizon(self) -> dict:
@@ -2553,6 +2756,8 @@ class SpmdSignSGDSession:
         in-program evaluation; the record lands once per horizon (atomic),
         and best_global_model.npz tracks the best HORIZON-BOUNDARY round
         (only boundary params are ever materialized on host)."""
+        import time as _time
+
         config = self.config
         params, weights, batches, save_dir = self._run_setup()
         rng_sharding = NamedSharding(self.mesh, P(None, "clients"))
@@ -2568,6 +2773,7 @@ class SpmdSignSGDSession:
             if fn is None:
                 fn = self._horizon_fns[h] = self._build_horizon_fn(h)
             boundary = round_number + h - 1
+            self._trace.maybe_profile_start(round_number, boundary)
             # same per-round streams as H=1: PRNGKey(seed + round), split
             # to slots — stacked into [H, n_slots, 2] scan rows (gather:
             # the selected rows of the identical splits, [H, s_pad, 2])
@@ -2599,6 +2805,7 @@ class SpmdSignSGDSession:
                     rng_sharding,
                 )
             rng_rows = put_sharded(np.stack(host_rng_rows), rng_sharding)
+            chunk_start = _time.monotonic()
             params, outs = self._watchdog.call(
                 lambda p=params, rr=rng_rows, w=weight_arg, i=idx_rows: fn(
                     p, rr, w, batches, i
@@ -2606,9 +2813,22 @@ class SpmdSignSGDSession:
                 phase="round",
                 round_number=boundary,
             )
+            self._trace.event(
+                "dispatch", program=f"horizon[h={h}]", round=boundary, rounds=h
+            )
             epoch_metrics = jax.tree.map(np.asarray, outs[0])  # [h, epochs]
             per_round = stacked_round_metrics(outs[1])
             confusion = np.asarray(outs[2]) if len(outs) > 2 else None
+            self._trace.event("host_sync", round=boundary)
+            chunk_seconds = _time.monotonic() - chunk_start
+            self._trace.span_record(
+                "horizon",
+                chunk_seconds,
+                first_round=round_number,
+                last_round=boundary,
+                rounds=h,
+            )
+            self._trace.count("rounds", h)
             for i in range(h):
                 metric = per_round[i]
                 if confusion is not None:
@@ -2617,7 +2837,13 @@ class SpmdSignSGDSession:
                     round_number + i,
                     metric,
                     {k: v[i] for k, v in epoch_metrics.items()},
+                    # in-chunk rounds don't materialize individually; the
+                    # chunk's amortized share matches the FedAvg fused rows
+                    round_seconds=chunk_seconds / h,
                 )
+            # see run(): no exit finalizer here, and the trace lands
+            # before the rows that cross-link it
+            self._trace.flush()
             atomic_json_dump(record_path, self._stat)
             if per_round[-1]["accuracy"] > best_saved_acc:
                 best_saved_acc = per_round[-1]["accuracy"]
@@ -2625,10 +2851,12 @@ class SpmdSignSGDSession:
                     os.path.join(save_dir, "best_global_model.npz"),
                     **{k: np.asarray(v) for k, v in params.items()},
                 )
+            self._trace.maybe_profile_stop(boundary)
             if self._fault_plan is not None:
                 for r in range(round_number, boundary + 1):
                     self._fault_plan.maybe_kill(r)
             round_number += h
+        self._trace.close()
         return {"performance": self._stat}
 
     @property

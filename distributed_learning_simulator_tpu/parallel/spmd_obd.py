@@ -611,19 +611,35 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         donate = (0, 1) if (phase_two or self._phase1_carries_opt) else (0,)
         jitted = jax.jit(round_program, donate_argnums=donate, **jit_kwargs)
 
+        # the OBD dispatch tail mirrors _wrap_round_programs: roundtrace's
+        # TraceRecorder.dispatch logs a `compile` event whenever the phase
+        # program's jit cache grew (enabled-gated int compare, no device
+        # touch)
+        phase_name = "phase2" if phase_two else "phase1"
+
         def fn(
             global_params, weights, rngs, bcast_rng, opt_state_s=None,
             sel_idx=None,
         ):
             with self._round_mesh_context():
                 if sel_idx is not None:
-                    return gather_jitted(
-                        global_params, opt_state_s, weights, rngs, sel_idx,
-                        bcast_rng, self._data,
+                    return self._trace.dispatch(
+                        f"{phase_name}[gather]",
+                        gather_jitted,
+                        (
+                            global_params, opt_state_s, weights, rngs,
+                            sel_idx, bcast_rng, self._data,
+                        ),
+                        sig_args=(weights, rngs, sel_idx),
                     )
-                return jitted(
-                    global_params, opt_state_s, weights, rngs, bcast_rng,
-                    self._data,
+                return self._trace.dispatch(
+                    f"{phase_name}[dense]",
+                    jitted,
+                    (
+                        global_params, opt_state_s, weights, rngs, bcast_rng,
+                        self._data,
+                    ),
+                    sig_args=(weights, rngs),
                 )
 
         fn._jitted = jitted
@@ -713,11 +729,20 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             ),
         )
 
+        program_name = (
+            f"obd_horizon[{'phase2' if phase_two else 'phase1'},h={horizon}]"
+        )
+
         def fn(global_params, opt_state_s, rng, weight_rows, idx_rows=None):
             with self._round_mesh_context():
-                return jitted(
-                    global_params, opt_state_s, rng, weight_rows, idx_rows,
-                    self._data, self._ensure_eval_batches(),
+                return self._trace.dispatch(
+                    program_name,
+                    jitted,
+                    (
+                        global_params, opt_state_s, rng, weight_rows,
+                        idx_rows, self._data, self._ensure_eval_batches(),
+                    ),
+                    sig_args=(weight_rows, idx_rows),
                 )
 
         fn._jitted = jitted
@@ -1079,6 +1104,8 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         aggregate chain is bit-identical to H=1.  ``early_stop`` needs
         every round's test metric on host before the next round may run,
         so it degrades fusion to per-round, loudly."""
+        import time as _time
+
         from ..engine.engine import (
             slow_metrics_from_confusion,
             stacked_round_metrics,
@@ -1187,7 +1214,9 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 phase=phase_label,
                 round_number=round_number,
             )
-            self.dispatch_count += 1
+            self._trace.event(
+                "dispatch", program=phase_label, round=round_number
+            )
             self._opt_state_s = opt_state_s  # observable continuation state
             return exact, bcast, {
                 k: float(np.asarray(v)) for k, v in metrics.items()
@@ -1221,8 +1250,12 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 else:
                     keys = [tick + i + 1 for i in range(h)]
                     tick += h
+                # profile_rounds keys off the stat keys (the OBD round
+                # numbering the record rows use)
+                self._trace.maybe_profile_start(keys[0], keys[-1])
                 if h == 1:
                     key = keys[0]
+                    round_start = _time.monotonic()
                     sel_host = None
                     if phase_two:
                         fn = self._phase2_fn
@@ -1238,16 +1271,27 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                         fn, train_params, weights, key, phase_label,
                         use_opt=carry_opt, sel_host=sel_host,
                     )
-                    metric = self._watchdog.call(
-                        lambda: self._evaluate(exact),
-                        phase="eval",
-                        round_number=key,
-                    )  # phase 2: check_acc semantics
-                    self.dispatch_count += 1
-                    self.host_sync_count += 1
-                    self.rounds_run += 1
+                    with self._trace.span("eval", round=key):
+                        metric = self._watchdog.call(
+                            lambda: self._evaluate(exact),
+                            phase="eval",
+                            round_number=key,
+                        )  # phase 2: check_acc semantics
+                    self._trace.event("dispatch", program="eval", round=key)
+                    self._trace.event("host_sync", round=key)
+                    self._trace.count("rounds")
+                    self._trace_fault_event(
+                        key,
+                        met.get("rejected_updates", 0),
+                        selected=(
+                            range(self.config.worker_number)
+                            if phase_two
+                            else None
+                        ),
+                    )
                     self._record_obd(
-                        key, metric, met, exact, save_dir, spec.name
+                        key, metric, met, exact, save_dir, spec.name,
+                        round_seconds=_time.monotonic() - round_start,
                     )
                     self._post_guard_quorum(
                         key, participating, met.get("rejected_updates", 0)
@@ -1280,6 +1324,7 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                     # into the fused program — pending background fetches
                     # must finish first
                     self._ckpt.barrier()
+                    chunk_start = _time.monotonic()
                     (exact, train_params, opt_state_s, rng), outs = (
                         self._watchdog.call(
                             lambda gp=train_params, o=opt_state_s, r=rng,
@@ -1289,15 +1334,29 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                         )
                     )
                     self._opt_state_s = opt_state_s
-                    self.dispatch_count += 1
+                    self._trace.event(
+                        "dispatch",
+                        program=f"obd_horizon[{phase_label},h={h}]",
+                        round=keys[-1],
+                        rounds=h,
+                    )
                     # ONE host sync per horizon: the stacked metric fetch
                     train_mets = {
                         k: np.asarray(v) for k, v in outs[0].items()
                     }
                     per_round = stacked_round_metrics(outs[1])
                     confusion = np.asarray(outs[2]) if len(outs) > 2 else None
-                    self.host_sync_count += 1
-                    self.rounds_run += h
+                    self._trace.event("host_sync", round=keys[-1])
+                    chunk_seconds = _time.monotonic() - chunk_start
+                    self._trace.span_record(
+                        "horizon",
+                        chunk_seconds,
+                        first_round=keys[0],
+                        last_round=keys[-1],
+                        rounds=h,
+                        phase=spec.name,
+                    )
+                    self._trace.count("rounds", h)
                     for i, key in enumerate(keys):
                         metric = per_round[i]
                         if confusion is not None:
@@ -1305,11 +1364,24 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                                 slow_metrics_from_confusion(confusion[i])
                             )
                         met = {k: float(v[i]) for k, v in train_mets.items()}
+                        self._trace_fault_event(
+                            key,
+                            met.get("rejected_updates", 0),
+                            selected=(
+                                range(self.config.worker_number)
+                                if phase_two
+                                else None
+                            ),
+                        )
                         # only the boundary's exact aggregate materialized
                         self._record_obd(
                             key, metric, met,
                             exact if key == keys[-1] else None,
                             save_dir, spec.name,
+                            # in-chunk rounds don't materialize individually;
+                            # the chunk's amortized share matches the FedAvg
+                            # fused rows
+                            round_seconds=chunk_seconds / h,
                         )
                         self._post_guard_quorum(
                             key,
@@ -1332,23 +1404,31 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                         "phase switch -> %s",
                         driver.phase and driver.phase.name,
                     )
+                    self._trace.event(
+                        "phase_switch",
+                        round=keys[-1],
+                        phase=(driver.phase.name if driver.phase else "end"),
+                    )
                 # kills fire only after the chunk's records, the boundary
                 # checkpoint, and the opt-state save are all queued — the
                 # writer drains on the raise (``with self._ckpt``), so the
                 # resume replay finds a consistent phase state
                 self._maybe_kill(keys[0], keys[-1])
+                self._trace.maybe_profile_stop(keys[-1])
                 if decision.end_training:
                     break
         return {"performance": self._stat}
 
     # ------------------------------------------------------------------
     def _record_obd(
-        self, stat_key, metric, round_metrics, exact, save_dir, phase_name=""
+        self, stat_key, metric, round_metrics, exact, save_dir, phase_name="",
+        round_seconds=0.0,
     ):
         mb = 1 / 8e6
         extra = {
             "received_mb": round_metrics["upload_bits"] * mb,
             "sent_mb": round_metrics["bcast_bits"] * mb,
+            "round_seconds": round_seconds,
             # which phase produced this aggregate — lets a resume replay
             # the driver's transitions from the record alone
             "phase": phase_name,

@@ -7,8 +7,8 @@ metric, append to ``round_record.json``, keep ``best_global_model``, early
 stop on a 5-round plateau, and cache the global model per round.
 """
 
-import json
 import os
+import time as _time
 from typing import Any
 
 import numpy as np
@@ -51,10 +51,30 @@ class AggregationServer(Server):
         # past it and the stateless plan never re-fires the same kill
         self._kill_armed_round: int | None = None
         self._last_saved_key = 0
-        import time as _time
-
         self.__round_start = _time.monotonic()
         self.__round_start_bytes = (0, 0)
+        # roundtrace telemetry (util/telemetry.py): the threaded executor
+        # shares the SPMD sessions' trace schema — worker `upload` events,
+        # a `round_barrier` span (first upload → all workers in), one
+        # `round` span per record row (its JSONL offset cross-linked as
+        # the row's trace_offset), and `fault` events.  Everything runs
+        # on the server sweep thread over host state it already owns.
+        from ..util.telemetry import TraceRecorder
+
+        self._trace = TraceRecorder.from_config(
+            self.config, default_dir=self.save_dir
+        )
+        if not (getattr(self.config, "telemetry", None) or {}).get("flush_every"):
+            # the server event loop has no try/finally around its sweep
+            # (Server.start runs _server_exit only on the clean path), so
+            # an abort mid-round (QuorumLostError, worker crash) would
+            # drop a buffered trace entirely.  This executor already
+            # writes its record synchronously every round — flush each
+            # trace record the same way unless the user chose a cadence
+            # (an explicit `flush_every: 0` means "auto" and gets the
+            # same eager default, not the recorder's 256-record buffer).
+            self._trace.flush_every = 1
+        self._upload_window_start: float | None = None
 
     @property
     def early_stop(self) -> bool:
@@ -123,9 +143,25 @@ class AggregationServer(Server):
 
     def _server_exit(self) -> None:
         self.__algorithm.exit()
+        self._trace.close()
 
     def _process_worker_data(self, worker_id: int, data: Message | None) -> None:
         assert 0 <= worker_id < self.worker_number
+        # telemetry.profile_rounds on this executor is server-observed:
+        # the window opens at the first upload the server sees for its
+        # first round and closes after the last round's record
+        self._trace.maybe_profile_start(self._round_number)
+        if self._trace.enabled:
+            if not self._worker_flag:
+                # the round barrier opens at its first upload; the span
+                # below measures how long the stragglers kept it open
+                self._upload_window_start = _time.monotonic()
+            self._trace.event(
+                "upload",
+                worker=worker_id,
+                round=self._round_number,
+                dropped=data is None,
+            )
         self.__algorithm.process_worker_data(
             worker_id=worker_id,
             worker_data=data,
@@ -134,6 +170,14 @@ class AggregationServer(Server):
         )
         self._worker_flag.add(worker_id)
         if len(self._worker_flag) == self.worker_number:
+            if self._trace.enabled and self._upload_window_start is not None:
+                self._trace.span_record(
+                    "round_barrier",
+                    _time.monotonic() - self._upload_window_start,
+                    round=self._round_number,
+                    workers=self.worker_number,
+                )
+                self._upload_window_start = None
             result = self._aggregate_worker_data()
             self._send_result(result)
             self._worker_flag.clear()
@@ -183,8 +227,12 @@ class AggregationServer(Server):
         if self.need_init_performance:
             assert self.config.distribute_init_parameters
         if self.need_init_performance and "init" in result.other_data:
-            self.__record_compute_stat(result.parameter, keep_performance_logger=False)
-            self.__stat[0] = self.__stat.pop(self._get_stat_key())
+            # keyed 0 directly (not rekeyed after the fact) so its trace
+            # span carries the row's real key and the distinct kind keeps
+            # tracedump's rounds_total an actual round count
+            self.__record_compute_stat(
+                result.parameter, keep_performance_logger=False, stat_key=0
+            )
         elif self._compute_stat and "init" not in result.other_data:
             self.__record_compute_stat(result.parameter)
             self._maybe_early_stop(result)
@@ -220,6 +268,7 @@ class AggregationServer(Server):
 
     def _after_send_result(self, result: Message) -> None:
         if isinstance(result, ParameterMessageBase) and not result.in_round:
+            self._trace.maybe_profile_stop(self._round_number)
             self._round_number += 1
             # FaultPlan process kills arm at their scheduled round but
             # fire only once a checkpoint ≥ that round is SAVED (record
@@ -259,7 +308,10 @@ class AggregationServer(Server):
         return {}
 
     def __record_compute_stat(
-        self, parameter_dict: Params, keep_performance_logger: bool = True
+        self,
+        parameter_dict: Params,
+        keep_performance_logger: bool = True,
+        stat_key: int | None = None,
     ) -> None:
         self.tester.set_visualizer_prefix(f"round: {self._round_number},")
         metric = self.get_metric(
@@ -268,8 +320,6 @@ class AggregationServer(Server):
         round_stat = {f"test_{k}": v for k, v in metric.items()}
         # first-class per-round profiling counters (SURVEY.md §5 TPU plan):
         # wall-clock + transport bytes since the previous round record
-        import time as _time
-
         now = _time.monotonic()
         round_stat["round_seconds"] = now - self.__round_start
         round_stat["received_mb"] = (
@@ -299,13 +349,48 @@ class AggregationServer(Server):
                 algo.skipped_workers & (dead | set(injected))
             )
         self._annotate_stat(round_stat)
-        key = self._get_stat_key()
+        key = self._get_stat_key() if stat_key is None else stat_key
         assert key not in self.__stat
+        if self._trace.enabled:
+            if "rejected_updates" in round_stat:
+                self._trace.event(
+                    "fault",
+                    round=key,
+                    rejected_updates=round_stat["rejected_updates"],
+                    dropped_clients=round_stat.get("dropped_clients", 0),
+                )
+            span_fields = {
+                "round": key,
+                "accuracy": metric.get("accuracy"),
+                "loss": metric.get("loss"),
+                "received_mb": round_stat["received_mb"],
+                "sent_mb": round_stat["sent_mb"],
+            }
+            if "rejected_updates" in round_stat:
+                span_fields["rejected_updates"] = round_stat[
+                    "rejected_updates"
+                ]
+            # the init-performance row (stat_key=0) is not a round: its
+            # own span kind keeps tracedump's rounds_total honest
+            round_stat["trace_offset"] = self._trace.span_record(
+                "round" if key else "init_eval",
+                round_stat["round_seconds"],
+                **span_fields,
+            )
         self.__stat[key] = round_stat
-        with open(
-            os.path.join(self.save_dir, "round_record.json"), "wt", encoding="utf8"
-        ) as f:
-            json.dump(self.__stat, f)
+        # the shared atomic-write helper (util/checkpoint.py): the record
+        # is the resume source of record rows on this executor too — a
+        # crash mid-write must never leave a torn file (the SPMD flusher
+        # has used this contract since PR 2; the threaded path's plain
+        # open() rewrite was the last non-atomic copy).  The trace lands
+        # first so durable rows never cross-link trace_offsets a resumed
+        # recorder would renumber (a no-op at the default eager cadence)
+        from ..util.checkpoint import atomic_json_dump
+
+        self._trace.flush()
+        atomic_json_dump(
+            os.path.join(self.save_dir, "round_record.json"), self.__stat
+        )
 
         max_acc = max(t["test_accuracy"] for t in self.__stat.values())
         if max_acc > self.__best_acc:

@@ -32,14 +32,28 @@ class CheckpointError(RuntimeError):
     instead of dying on an AssertionError with no message."""
 
 
-def atomic_json_dump(path: str, obj) -> None:
-    """Write ``obj`` as JSON via a tmp file + rename: a reader (or a crash
-    mid-write) never sees a torn file — the contract round_record.json
-    needs now that it is the resume source of record rows."""
-    tmp = f"{path}.tmp"
-    with open(tmp, "wt", encoding="utf8") as f:
-        json.dump(obj, f)
+def atomic_write(path: str, write_fn, suffix: str = ".tmp") -> None:
+    """THE shared tmp-file + rename helper: ``write_fn(tmp_path)`` writes
+    the payload to a sibling tmp file, which is then renamed over
+    ``path`` — a reader (or a crash mid-write) never sees a torn file.
+    One definition for every atomic artifact writer (the JSON record
+    flushers on both executors, the npz checkpoint writer, the best-model
+    promotion copy) so the torn-file contract can't drift per call site."""
+    tmp = f"{path}{suffix}"
+    write_fn(tmp)
     os.replace(tmp, path)
+
+
+def atomic_json_dump(path: str, obj) -> None:
+    """Write ``obj`` as JSON atomically — the contract round_record.json
+    needs now that it is the resume source of record rows (shared by the
+    SPMD sessions AND the threaded server's record flusher)."""
+
+    def _write(tmp: str) -> None:
+        with open(tmp, "wt", encoding="utf8") as f:
+            json.dump(obj, f)
+
+    atomic_write(path, _write)
 
 
 class AsyncCheckpointWriter:
@@ -115,10 +129,12 @@ class AsyncCheckpointWriter:
 
         def _write() -> None:
             host = {k: np.asarray(v) for k, v in params.items()}
-            tmp = f"{path}.tmp.npz"
-            with open(tmp, "wb") as f:
-                np.savez(f, **host)
-            os.replace(tmp, path)
+
+            def _savez(tmp: str) -> None:
+                with open(tmp, "wb") as f:
+                    np.savez(f, **host)
+
+            atomic_write(path, _savez, suffix=".tmp.npz")
             succeeded[0] = True
 
         self._submit(_write)
@@ -144,9 +160,9 @@ class AsyncCheckpointWriter:
                 # the save that produced ``source`` failed — don't promote
                 # a stale file a previous run may have left at that path
                 return
-            tmp = f"{path}.tmp.npz"
-            shutil.copyfile(source, tmp)
-            os.replace(tmp, path)
+            atomic_write(
+                path, lambda tmp: shutil.copyfile(source, tmp), suffix=".tmp.npz"
+            )
 
         self._submit(_copy)
 

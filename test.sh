@@ -19,6 +19,26 @@ for cfg in fed_avg/mnist fed_avg/imdb; do
     ++$algo.round=1 ++$algo.epoch=1 ++$algo.worker_number=2 ++$algo.debug=True
 done
 
+# roundtrace telemetry smoke (PR 10): the recorder rides the real run
+# loops on every executor — the threaded server (round barrier + upload
+# spans), the fused SPMD fed_avg path, and the whole-mesh ep layout (the
+# fault smoke below runs with telemetry enabled) — and the fused trace
+# must certify the dispatch budget through the tracedump gate at the end.
+TRACE_SMOKE=/tmp/dls_tpu_smoke_telemetry
+rm -rf "$TRACE_SMOKE"
+for exec_mode in sequential spmd; do
+  extra=""
+  if [ "$exec_mode" = spmd ]; then
+    extra="++fed_avg.algorithm_kwargs.round_horizon=4"
+  fi
+  run --config-name fed_avg/mnist.yaml \
+    ++fed_avg.round=4 ++fed_avg.epoch=1 ++fed_avg.worker_number=2 \
+    ++fed_avg.executor=$exec_mode \
+    ++fed_avg.dataset_kwargs.train_size=128 ++fed_avg.dataset_kwargs.test_size=64 \
+    ++fed_avg.telemetry.enabled=True \
+    ++fed_avg.save_dir=$TRACE_SMOKE/$exec_mode $extra
+done
+
 # fault-injection smoke (util/faults.py): a seeded FaultPlan drops ~30% of
 # clients per round and corrupts one upload; the update guard must reject
 # the poison, the quorum must hold, and the run must finish — on BOTH
@@ -45,6 +65,8 @@ done
 # guard code paths are identical at any ep size); the model is shrunk to
 # keep the XLA:CPU compile time bounded.
 run --config-name large_scale/fed_obd/moe_imdb_ep.yaml \
+  ++fed_obd.telemetry.enabled=True \
+  ++fed_obd.save_dir=$TRACE_SMOKE/ep \
   ++fed_obd.round=2 ++fed_obd.epoch=1 ++fed_obd.worker_number=4 \
   ++fed_obd.algorithm_kwargs.random_client_number=3 \
   ++fed_obd.algorithm_kwargs.second_phase_epoch=1 \
@@ -61,6 +83,18 @@ run --config-name large_scale/fed_obd/moe_imdb_ep.yaml \
   ++fed_obd.fault_tolerance.dropout_rate=0.3 \
   ++fed_obd.fault_tolerance.corrupt_schedule.2='[0]' \
   ++fed_obd.fault_tolerance.update_guard=True
+
+# roundtrace gates (tools/tracedump): the fused SPMD smoke trace must
+# hold the dispatch budget at runtime (the same invariant shardcheck
+# certified statically above) and observe zero retraces; every
+# telemetry-on trace must round-trip through the JSON summarizer
+python3 -m tools.tracedump "$TRACE_SMOKE/spmd/server/trace.jsonl" \
+  --assert-budget "dispatches_per_round<=1" \
+  --assert-budget "retrace_events==0"
+python3 -m tools.tracedump "$TRACE_SMOKE/sequential/server/trace.jsonl" \
+  --format json > /dev/null
+python3 -m tools.tracedump "$TRACE_SMOKE/ep/server/trace.jsonl" \
+  --format json > /dev/null
 
 run --config-name fed_gnn/cs.yaml \
   ++fed_gnn.round=1 ++fed_gnn.epoch=1 ++fed_gnn.worker_number=2

@@ -121,6 +121,19 @@ def test_bench_main_prints_one_json_line(monkeypatch):
     monkeypatch.setattr(bench, "measure_shardcheck", lambda: 0)
     monkeypatch.setattr(
         bench,
+        "measure_telemetry",
+        lambda: {
+            "model": "LeNet5/MNIST",
+            "horizon": bench.TEL_HORIZON,
+            "off": {"rounds_per_sec": 1.0, "seconds_per_round": 1.0},
+            "on": {"rounds_per_sec": 0.99, "seconds_per_round": 1.01},
+            "telemetry_overhead_fraction": 0.01,
+            "retrace_events": 0,
+            "trace_records": 42,
+        },
+    )
+    monkeypatch.setattr(
+        bench,
         "measure_fault_tolerance",
         lambda: {
             "model": "LeNet5/MNIST",
@@ -160,6 +173,9 @@ def test_bench_main_prints_one_json_line(monkeypatch):
         "ep_fusion",
         "dropout_overhead_fraction",
         "fault_tolerance",
+        "telemetry_overhead_fraction",
+        "retrace_events",
+        "telemetry",
         "lint_findings",
         "shardcheck_findings",
     ):
@@ -201,6 +217,11 @@ def test_bench_main_prints_one_json_line(monkeypatch):
     # fraction mirrors the measurement's own field)
     assert payload["dropout_overhead_fraction"] == 0.02
     assert "masked" in payload["fault_tolerance"]
+    # roundtrace telemetry: the on-vs-off A/B surfaces its overhead
+    # fraction and the trace's retrace count at top level
+    assert payload["telemetry_overhead_fraction"] == 0.01
+    assert payload["retrace_events"] == 0
+    assert "on" in payload["telemetry"]
     # analyzer health: the audited jaxlint finding count (count only —
     # the per-finding detail lives in the analyzer's own JSON output)
     assert payload["lint_findings"] == 38
@@ -228,6 +249,7 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     monkeypatch.setattr(bench, "measure_ep_fusion", boom)
     monkeypatch.setattr(bench, "measure_selection_gather", boom)
     monkeypatch.setattr(bench, "measure_fault_tolerance", boom)
+    monkeypatch.setattr(bench, "measure_telemetry", boom)
     monkeypatch.setattr(bench, "measure_lint", boom)
     monkeypatch.setattr(bench, "measure_shardcheck", boom)
     out = io.StringIO()
@@ -263,6 +285,11 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     # fraction degrades to -1 (the -1/absent-never contract)
     assert "error" in payload["fault_tolerance"]
     assert payload["dropout_overhead_fraction"] == -1.0
+    # telemetry A/B degrades the same way: error marker + -1 top-level
+    # fields, never missing
+    assert "error" in payload["telemetry"]
+    assert payload["telemetry_overhead_fraction"] == -1.0
+    assert payload["retrace_events"] == -1
     # lint count degrades to -1 (never a missing field, never a crash)
     assert payload["lint_findings"] == -1
     # shardcheck count degrades the same way (-1/absent-never)

@@ -82,6 +82,7 @@ def _assert_matching_trajectories(sharded, base):
         )
 
 
+@pytest.mark.slow  # ~36s: ep-vs-client-axis whole-run parity; tier-1 budget (PR 10 re-tier)
 def test_fed_obd_expert_parallel_matches_client_axis():
     config = _moe_config(expert_parallel=4)
     assert resolve_executor(config) == "spmd"

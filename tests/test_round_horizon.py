@@ -449,6 +449,7 @@ def test_expert_parallel_h1_vs_h4_bit_exact(tmp_session_dir):
     assert session._horizon_fns[4]._jitted._cache_size() == 1
 
 
+@pytest.mark.slow  # ~40s: ep-OBD fused-parity e2e; tier-1 budget (PR 10 re-tier)
 def test_obd_expert_parallel_h1_vs_h2_bit_exact_across_phase_boundary(
     tmp_session_dir,
 ):

@@ -381,6 +381,9 @@ def test_expert_parallel_gather_vs_dense_bit_exact(tmp_session_dir):
     assert session._jitted_round_fn._cache_size() == 0
 
 
+@pytest.mark.slow  # ~43s: heaviest ep-OBD e2e; tier-1 budget (PR 10 re-tier
+# per the PR 3 precedent) — the ep layout keeps tier-1 coverage via the
+# shardcheck fed_obd::ep cell, the ep fed_avg fusion pins, and the ep fault pins
 def test_obd_expert_parallel_gather_vs_dense_bit_exact(tmp_session_dir):
     """FedOBD on the expert-parallel layout: gather-vs-dense bit-exact
     through the phase-2 switch, including the wire accounting and the

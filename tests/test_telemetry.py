@@ -1,0 +1,454 @@
+"""roundtrace (PR 10): structured telemetry must be observability ONLY —
+bit-exact trajectories and an unchanged dispatch/host-sync budget with
+``config.telemetry.enabled``, a bit-exact no-op (no file, no record
+fields) without it, a JSONL schema that round-trips through
+``tools.tracedump``, a ``--diff`` that flags an injected +1
+dispatch/round regression, and fault events that match the PR 7 chaos
+counters."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from conftest import fed_avg_config
+from distributed_learning_simulator_tpu.training import _build_task, train
+from tools.tracedump import (
+    TraceError,
+    check_budget,
+    diff_summaries,
+    load_trace,
+    summarize,
+)
+
+
+def _config(rounds, save_dir, telemetry=None, horizon=1, **overrides):
+    algorithm_kwargs = dict(overrides.pop("algorithm_kwargs", {}))
+    if horizon != 1:
+        algorithm_kwargs["round_horizon"] = horizon
+    config = fed_avg_config(
+        executor=overrides.pop("executor", "spmd"),
+        worker_number=overrides.pop("worker_number", 2),
+        round=rounds,
+        batch_size=32,
+        epoch=1,
+        save_dir=save_dir,
+        dataset_kwargs={"train_size": 64, "val_size": 16, "test_size": 32},
+        algorithm_kwargs=algorithm_kwargs,
+        **overrides,
+    )
+    if telemetry is not None:
+        config.telemetry = telemetry
+    config.load_config_and_process()
+    return config
+
+
+def _trace_path(save_dir):
+    return os.path.join(save_dir, "server", "trace.jsonl")
+
+
+def _record(save_dir):
+    with open(os.path.join(save_dir, "server", "round_record.json")) as f:
+        return json.load(f)
+
+
+def _final_params(save_dir, round_number):
+    with np.load(
+        os.path.join(save_dir, "aggregated_model", f"round_{round_number}.npz")
+    ) as blob:
+        return {k: blob[k] for k in blob.files}
+
+
+def _session(config):
+    from distributed_learning_simulator_tpu.parallel.spmd import (
+        SpmdFedAvgSession,
+    )
+
+    ctx = _build_task(config)
+    return SpmdFedAvgSession(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+
+
+def test_telemetry_off_is_bit_exact_and_fileless(tmp_session_dir):
+    """The acceptance pin's off half: a default (telemetry-absent) run
+    and a telemetry-on run produce IDENTICAL params and identical record
+    rows (modulo the on-path's trace_offset cross-link and wall-clock
+    fields); the off path writes no trace file and no extra fields."""
+    r_off = train(_config(rounds=2, save_dir="off", horizon=2))
+    r_on = train(
+        _config(
+            rounds=2, save_dir="on", horizon=2, telemetry={"enabled": True}
+        )
+    )
+    for rn in r_off["performance"]:
+        a, b = r_off["performance"][rn], r_on["performance"][rn]
+        assert a["test_accuracy"] == b["test_accuracy"], rn
+        assert a["test_loss"] == b["test_loss"], rn
+    p_off = _final_params("off", 2)
+    p_on = _final_params("on", 2)
+    for key in p_off:
+        np.testing.assert_array_equal(p_off[key], p_on[key])
+    assert not os.path.isfile(_trace_path("off"))
+    assert os.path.isfile(_trace_path("on"))
+    rec_off, rec_on = _record("off"), _record("on")
+    assert not any("trace_offset" in row for row in rec_off.values())
+    assert all("trace_offset" in row for row in rec_on.values())
+    # identical surfaces apart from the cross-link and wall time
+    for key, row in rec_off.items():
+        on_row = dict(rec_on[key])
+        on_row.pop("trace_offset")
+        assert set(on_row) == set(row)
+        for field, value in row.items():
+            if field != "round_seconds":
+                assert on_row[field] == value, (key, field)
+
+
+def test_telemetry_on_adds_zero_dispatches_on_fused_h4(tmp_session_dir):
+    """The acceptance pin's on half: with telemetry enabled on the fused
+    fed_avg H=4 session, dispatches/round and host syncs/round are
+    UNCHANGED vs telemetry-off, and the legacy counter attributes (now
+    recorder-derived properties) carry the exact PR 2 values."""
+    counts = {}
+    for arm, telemetry in (("off", None), ("on", {"enabled": True})):
+        session = _session(
+            _config(rounds=8, save_dir=arm, horizon=4, telemetry=telemetry)
+        )
+        session.run()
+        counts[arm] = (
+            session.dispatch_count,
+            session.host_sync_count,
+            session.rounds_run,
+        )
+    assert counts["on"] == counts["off"] == (2, 2, 8)
+    summary = summarize(load_trace(_trace_path("on")))
+    # the trace's runtime budget equals the counter-derived one
+    assert summary["budget"]["rounds_total"] == 8
+    assert summary["budget"]["dispatches_total"] == 2
+    assert summary["budget"]["host_syncs_total"] == 2
+    assert summary["budget"]["dispatches_per_round"] == pytest.approx(0.25)
+    # no retrace across the two chunks: one compile event, retrace-free
+    assert summary["budget"]["retrace_events"] == 0
+    assert summary["programs"].get("horizon[h=4]") == 1
+    assert not check_budget(summary, ["dispatches_per_round<=1"])
+
+
+def test_trace_schema_roundtrips_through_tracedump_json(
+    tmp_session_dir, capsys
+):
+    """The JSONL schema contract: the per-round (H=1) loop's spans and
+    events survive `python -m tools.tracedump --format json`, and each
+    record row's trace_offset indexes its own round's span line."""
+    train(_config(rounds=2, save_dir="t", telemetry={"enabled": True}))
+    path = _trace_path("t")
+    records = load_trace(path)
+    by_offset = {r["i"]: r for r in records}
+    # record rows cross-link their round spans by line offset (== `i`)
+    for key, row in _record("t").items():
+        span = by_offset[row["trace_offset"]]
+        assert span["ev"] == "span" and span["kind"] == "round"
+        assert span["round"] == int(key)
+        assert span["accuracy"] == row["test_accuracy"]
+    from tools.tracedump.__main__ import main
+
+    assert main([path, "--format", "json"]) == 0
+    payload = json.loads(capsys.readouterr().out.strip())
+    assert payload["meta"]["executor"] == "spmd"
+    assert payload["spans"]["round"]["count"] == 2
+    assert payload["spans"]["eval"]["count"] == 2
+    # H=1 budget: fold_rngs + round + eval dispatches, one sync per round
+    assert payload["budget"]["dispatches_per_round"] == pytest.approx(3.0)
+    assert payload["budget"]["host_syncs_per_round"] == pytest.approx(1.0)
+    assert payload["budget"]["sent_mb_total"] > 0
+    # compile events for the round program: first compile, no retrace
+    compile_events = [
+        r for r in records if r.get("kind") == "compile"
+    ]
+    assert any(e["program"] == "round[dense]" for e in compile_events)
+    assert payload["budget"]["retrace_events"] == 0
+    assert payload["budget_failures"] == []
+
+
+def _write_synthetic_trace(path, rounds, dispatches_per_round):
+    """Hand-written trace in the recorder's schema — the CLI-contract
+    tests must not pay for a training run each."""
+    lines = [
+        {
+            "i": 0,
+            "t": 0.0,
+            "ev": "meta",
+            "kind": "trace",
+            "version": 1,
+            "executor": "spmd",
+        }
+    ]
+    for rn in range(1, rounds + 1):
+        for _ in range(dispatches_per_round):
+            lines.append(
+                {
+                    "i": len(lines),
+                    "t": float(rn),
+                    "ev": "event",
+                    "kind": "dispatch",
+                    "program": "round",
+                    "round": rn,
+                }
+            )
+        lines.append(
+            {
+                "i": len(lines),
+                "t": float(rn),
+                "ev": "event",
+                "kind": "host_sync",
+                "round": rn,
+            }
+        )
+        lines.append(
+            {
+                "i": len(lines),
+                "t": float(rn),
+                "ev": "span",
+                "kind": "round",
+                "dur": 0.5,
+                "round": rn,
+                "accuracy": 0.5,
+                "loss": 1.0,
+                "sent_mb": 1.0,
+                "received_mb": 1.0,
+            }
+        )
+    with open(path, "wt") as f:
+        for line in lines:
+            f.write(json.dumps(line) + "\n")
+
+
+def test_tracedump_diff_flags_injected_dispatch_regression(
+    tmp_session_dir, capsys
+):
+    """`--diff` is the regression gate: a candidate trace with one extra
+    dispatch per round vs the baseline must be flagged (exit 1)."""
+    _write_synthetic_trace("base.jsonl", rounds=4, dispatches_per_round=1)
+    _write_synthetic_trace(
+        "regressed.jsonl", rounds=4, dispatches_per_round=2
+    )
+    diff = diff_summaries(
+        summarize(load_trace("regressed.jsonl")),
+        summarize(load_trace("base.jsonl")),
+    )
+    assert diff["regressions"], diff
+    assert diff["deltas"]["dispatches_per_round"]["delta"] == pytest.approx(
+        1.0
+    )
+    from tools.tracedump.__main__ import main
+
+    assert main(["regressed.jsonl", "--diff", "base.jsonl"]) == 1
+    capsys.readouterr()
+    # the unregressed self-diff is clean
+    assert main(["base.jsonl", "--diff", "base.jsonl"]) == 0
+    capsys.readouterr()
+
+
+def test_assert_budget_cli_contract(tmp_session_dir, capsys):
+    _write_synthetic_trace("t.jsonl", rounds=4, dispatches_per_round=1)
+    from tools.tracedump.__main__ import main
+
+    assert (
+        main(["t.jsonl", "--assert-budget", "dispatches_per_round<=1"]) == 0
+    )
+    capsys.readouterr()
+    assert (
+        main(["t.jsonl", "--assert-budget", "dispatches_per_round<=0.01"])
+        == 1
+    )
+    capsys.readouterr()
+    assert main(["t.jsonl", "--assert-budget", "not an expression"]) == 2
+    capsys.readouterr()
+    with pytest.raises(TraceError):
+        check_budget(summarize(load_trace("t.jsonl")), ["no_such_key<=1"])
+
+
+def test_fault_events_match_chaos_counters(tmp_session_dir):
+    """Fault observability parity with the PR 7 chaos suite: the trace's
+    per-round `fault` events carry the SAME rejected_updates the record
+    rows fetched at the round's one sync point, and dropped_clients
+    matches the FaultPlan's injected schedule over the selected cohort."""
+    from distributed_learning_simulator_tpu.util.faults import FaultPlan
+    from distributed_learning_simulator_tpu.utils.selection import (
+        select_workers,
+    )
+
+    config = _config(
+        rounds=3,
+        save_dir="chaos",
+        worker_number=4,
+        telemetry={"enabled": True},
+        fault_tolerance={
+            "seed": 1,
+            "dropout_rate": 0.4,
+            "corrupt_schedule": {2: [0]},
+            "update_guard": True,
+        },
+        algorithm_kwargs={"min_client_quorum": 1},
+    )
+    train(config)
+    records = load_trace(_trace_path("chaos"))
+    fault_events = {
+        r["round"]: r for r in records if r.get("kind") == "fault"
+    }
+    record_rows = _record("chaos")
+    assert set(fault_events) == {1, 2, 3}
+    plan = FaultPlan.from_config(config)
+    for rn in (1, 2, 3):
+        assert (
+            fault_events[rn]["rejected_updates"]
+            == record_rows[str(rn)]["rejected_updates"]
+        )
+        selected = set(
+            select_workers(config.seed, rn, config.worker_number, None)
+        )
+        expected_dropped = len(
+            plan.dropped_clients(rn, config.worker_number) & selected
+        )
+        assert fault_events[rn]["dropped_clients"] == expected_dropped
+    summary = summarize(records)
+    assert summary["budget"]["rejected_updates_total"] == sum(
+        row["rejected_updates"] for row in record_rows.values()
+    )
+
+
+def test_threaded_executor_trace(tmp_session_dir):
+    """The threaded executor speaks the same schema: upload events, a
+    round_barrier span per round, round spans cross-linked from the
+    (now atomically written) record rows."""
+    config = _config(rounds=2, save_dir="thr", executor="sequential")
+    config.telemetry = {"enabled": True}
+    train(config)
+    records = load_trace(_trace_path("thr"))
+    summary = summarize(records)
+    assert summary["meta"]["executor"] == "sequential"
+    assert summary["spans"]["round"]["count"] == 2
+    assert summary["spans"]["round_barrier"]["count"] == 2
+    # 2 workers × 2 rounds
+    assert summary["events"]["upload"] == 4
+    by_offset = {r["i"]: r for r in records}
+    for key, row in _record("thr").items():
+        span = by_offset[row["trace_offset"]]
+        assert span["kind"] == "round" and span["round"] == int(key)
+
+
+def test_trace_appends_continue_offsets_and_tolerate_torn_tail(
+    tmp_session_dir,
+):
+    """Sessions sharing a save_dir append to ONE trace: a later recorder
+    continues offsets from the existing line count (terminating a torn
+    tail from a crashed predecessor in place), every record's `i` equals
+    its line index, and the reader skips the torn line."""
+    from distributed_learning_simulator_tpu.util.telemetry import (
+        TraceRecorder,
+    )
+
+    first = TraceRecorder(enabled=True, path="t.jsonl", flush_every=1)
+    assert first.event("dispatch", program="round", round=1) == 1  # meta=0
+    with open("t.jsonl", "at") as f:
+        f.write('{"i": 2, "t"')  # crash mid-append: torn, unterminated
+    second = TraceRecorder(enabled=True, path="t.jsonl", flush_every=1)
+    # line 2 is the (now terminated) torn line; the new meta lands at 3
+    assert second.event("dispatch", program="round", round=2) == 4
+    records = load_trace("t.jsonl")
+    assert [r["i"] for r in records] == [0, 1, 3, 4]
+    with open("t.jsonl") as f:
+        lines = f.read().splitlines()
+    for record in records:
+        assert json.loads(lines[record["i"]]) == record
+    assert summarize(records)["budget"]["dispatches_total"] == 2
+
+
+def test_unknown_telemetry_key_raises(tmp_session_dir):
+    from distributed_learning_simulator_tpu.util.telemetry import (
+        TraceRecorder,
+    )
+
+    config = _config(rounds=1, save_dir="bad")
+    config.telemetry = {"enabled": True, "typo_knob": 3}
+    with pytest.raises(ValueError, match="typo_knob"):
+        TraceRecorder.from_config(config)
+    config.telemetry = {"enabled": True, "profile_rounds": [3, 1]}
+    with pytest.raises(ValueError, match="profile_rounds"):
+        TraceRecorder.from_config(config)
+
+
+@pytest.mark.slow
+def test_profile_rounds_window(tmp_session_dir):
+    """`telemetry.profile_rounds: [a, b]` wraps those rounds in a
+    jax.profiler capture next to the trace; start/stop events land in
+    the stream."""
+    train(
+        _config(
+            rounds=3,
+            save_dir="prof",
+            telemetry={"enabled": True, "profile_rounds": [2, 2]},
+        )
+    )
+    records = load_trace(_trace_path("prof"))
+    actions = [
+        (r["action"], r["round"])
+        for r in records
+        if r.get("kind") == "profile"
+    ]
+    assert actions == [("start", 2), ("stop", 2)]
+    profile_dir = os.path.join("prof", "server", "profile_rounds")
+    assert os.path.isdir(profile_dir)
+    assert any(os.scandir(profile_dir))
+
+
+def test_profile_window_snaps_to_fused_chunk(tmp_session_dir, monkeypatch):
+    """A `profile_rounds` window that starts MID-chunk under round-horizon
+    fusion still opens at that chunk (and a chunk fully covering the
+    window opens AND closes at its boundaries) — the snap-outward rule
+    from docs/observability.md.  Gating logic only; the profiler itself
+    is stubbed."""
+    import jax
+
+    from distributed_learning_simulator_tpu.util.telemetry import (
+        TraceRecorder,
+    )
+
+    calls = []
+    monkeypatch.setattr(
+        jax.profiler, "start_trace", lambda d: calls.append("start")
+    )
+    monkeypatch.setattr(
+        jax.profiler, "stop_trace", lambda: calls.append("stop")
+    )
+
+    # window [2, 3] inside one H=4 chunk covering rounds 1..4
+    rec = TraceRecorder(
+        enabled=True, path="snap.jsonl", flush_every=1, profile_rounds=(2, 3)
+    )
+    rec.maybe_profile_start(1, 4)
+    assert calls == ["start"]
+    rec.maybe_profile_stop(4)
+    assert calls == ["start", "stop"]
+
+    # a chunk entirely BEFORE the window must not open it...
+    calls.clear()
+    rec = TraceRecorder(
+        enabled=True, path="snap2.jsonl", flush_every=1, profile_rounds=(5, 6)
+    )
+    rec.maybe_profile_start(1, 4)
+    assert calls == []
+    # ...and one entirely AFTER it (resume past the window) must not either
+    rec.maybe_profile_start(7, 8)
+    assert calls == []
+    records = load_trace("snap.jsonl")
+    actions = [
+        (r["action"], r["round"])
+        for r in records
+        if r.get("kind") == "profile"
+    ]
+    assert actions == [("start", 1), ("stop", 4)]

@@ -13,7 +13,9 @@ training — certifies four invariant classes per session:
    compiled/pinned output layouts leaf-for-leaf (the PR 8 opt-carry
    donation-aliasing class);
 3. **dispatch-budget** — one lowered module per horizon, and two rounds
-   with different selections hit the same jit cache entry;
+   with different selections hit the same jit cache entry (the runtime
+   twin: roundtrace ``compile`` events + ``tracedump --assert-budget
+   "retrace_events==0"`` observe the same invariant on live runs);
 4. **conf-capability** — every ``conf/**/*.yaml`` fused-round knob is
    validated against the session class's ``capability_gates``.
 

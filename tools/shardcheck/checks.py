@@ -14,7 +14,13 @@ sessions expose pre-dispatch (``parallel/introspect.py``):
 * ``dispatch-budget`` — two rounds with different host-side selections
   present identical abstract signatures (same jit cache entry — no
   retrace as selections change), and a fused horizon returns
-  ``[H]``-stacked metrics (one module, one sync per horizon).
+  ``[H]``-stacked metrics (one module, one sync per horizon).  The SAME
+  invariant is observable at runtime: with ``config.telemetry.enabled``
+  the sessions' dispatch tails log a roundtrace ``compile`` event
+  whenever a jit cache grows (``retrace: true`` past the first entry),
+  so ``python -m tools.tracedump --assert-budget "retrace_events==0"``
+  gates dynamically what this rule certifies statically
+  (docs/observability.md).
 
 Everything here is ``jax.eval_shape`` + ``jax.jit(...).lower()`` (and
 the lowering's AOT compile for the layout truth) — no execution, no

@@ -1,0 +1,294 @@
+"""tracedump: summarize, diff, and budget-gate roundtrace JSONL traces.
+
+The roundtrace recorder (``distributed_learning_simulator_tpu/util/
+telemetry.py``) streams span/event records — round/horizon/eval spans,
+per-dispatch and per-host-sync events, jit-cache ``compile`` events,
+fault events — to ``<save_dir>/server/trace.jsonl`` on every executor.
+This tool is the read side: one summary structure that bench, tests,
+``test.sh``, and humans all derive from the same file::
+
+    python -m tools.tracedump <trace.jsonl>                 # text summary
+    python -m tools.tracedump <trace> --format json         # machine-readable
+    python -m tools.tracedump <trace> --diff <baseline>     # regression diff
+    python -m tools.tracedump <trace> \
+        --assert-budget "dispatches_per_round<=1"           # CI gate
+
+Exit status: 0 clean; 1 on a failed ``--assert-budget`` expression or a
+``--diff`` budget regression (dispatches / host syncs / retraces per
+round increased vs the baseline); 2 on usage errors (missing file,
+unknown budget key, unparseable expression).
+
+The summary's ``budget`` block is the gate surface:
+
+* ``rounds_total`` — ``round`` span count;
+* ``dispatches_per_round`` / ``host_syncs_per_round`` — the runtime
+  twins of the sessions' ``dispatch_count``/``host_sync_count``
+  counters (pinned identical by ``tests/test_telemetry.py``);
+* ``compile_events`` / ``retrace_events`` — jit cache growth observed
+  at dispatch tails; ``retrace_events`` > 0 means a program re-traced
+  after its first compile (the invariant ``tools/shardcheck``'s
+  ``dispatch-budget`` rule certifies statically);
+* wire totals (``sent_mb_total``/``received_mb_total``) and fault
+  totals (``rejected_updates_total``/``dropped_clients_total``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+from typing import Any
+
+#: budget keys whose INCREASE vs a ``--diff`` baseline is a regression
+REGRESSION_KEYS = (
+    "dispatches_per_round",
+    "host_syncs_per_round",
+    "retraces_per_round",
+)
+
+_EXPR_RE = re.compile(
+    r"^\s*(?P<key>[A-Za-z_][A-Za-z0-9_]*)\s*"
+    r"(?P<op><=|>=|==|!=|<|>)\s*(?P<value>-?[0-9.]+)\s*$"
+)
+
+_OPS = {
+    "<=": lambda a, b: a <= b,
+    ">=": lambda a, b: a >= b,
+    "==": lambda a, b: math.isclose(a, b, rel_tol=1e-9, abs_tol=1e-9),
+    "!=": lambda a, b: not math.isclose(a, b, rel_tol=1e-9, abs_tol=1e-9),
+    "<": lambda a, b: a < b,
+    ">": lambda a, b: a > b,
+}
+
+
+class TraceError(ValueError):
+    """Unreadable trace or malformed budget expression (CLI exit 2)."""
+
+
+def load_trace(path: str) -> list[dict]:
+    """Parse one JSONL trace.  Torn lines (a crash mid-append; a later
+    session terminates the torn tail in place and appends after it, so
+    the tear can sit mid-file) are skipped — the surviving records'
+    ``i`` field still equals their 0-based line index, which is what the
+    ``trace_offset`` cross-link relies on.  A non-empty file with NO
+    parseable record raises: that is not a roundtrace stream at all."""
+    records: list[dict] = []
+    try:
+        with open(path, encoding="utf8") as f:
+            lines = f.read().splitlines()
+    except OSError as exc:
+        raise TraceError(f"cannot read trace {path!r}: {exc}") from exc
+    seen_content = False
+    for line in lines:
+        if not line.strip():
+            continue
+        seen_content = True
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue  # torn line from a crashed session — tolerated
+    if seen_content and not records:
+        raise TraceError(f"{path}: no parseable JSONL trace records")
+    return records
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def summarize(records: list[dict]) -> dict[str, Any]:
+    """The one summary structure every consumer reads (see module
+    docstring).  Pure host arithmetic over parsed records."""
+    spans: dict[str, list[float]] = {}
+    events: dict[str, int] = {}
+    compile_events = 0
+    retrace_events = 0
+    programs: dict[str, int] = {}
+    sent_mb = 0.0
+    received_mb = 0.0
+    rejected = 0.0
+    dropped = 0.0
+    meta: dict = {}
+    for record in records:
+        ev = record.get("ev")
+        kind = record.get("kind", "")
+        if ev == "meta":
+            meta = {
+                k: v
+                for k, v in record.items()
+                if k not in ("i", "t", "ev", "kind")
+            }
+        elif ev == "span":
+            spans.setdefault(kind, []).append(float(record.get("dur", 0.0)))
+            if kind == "round":
+                sent_mb += float(record.get("sent_mb", 0.0) or 0.0)
+                received_mb += float(record.get("received_mb", 0.0) or 0.0)
+        elif ev == "event":
+            events[kind] = events.get(kind, 0) + 1
+            if kind == "compile":
+                compile_events += 1
+                program = str(record.get("program", "?"))
+                programs[program] = max(
+                    programs.get(program, 0), int(record.get("cache_size", 1))
+                )
+                if record.get("retrace"):
+                    retrace_events += 1
+            elif kind == "fault":
+                rejected += float(record.get("rejected_updates", 0) or 0)
+                dropped += float(record.get("dropped_clients", 0) or 0)
+
+    span_stats: dict[str, dict] = {}
+    for kind, durations in spans.items():
+        ordered = sorted(durations)
+        span_stats[kind] = {
+            "count": len(ordered),
+            "total_s": round(sum(ordered), 6),
+            "mean_s": round(sum(ordered) / len(ordered), 6),
+            "p50_s": round(_percentile(ordered, 0.50), 6),
+            "p90_s": round(_percentile(ordered, 0.90), 6),
+            "max_s": round(ordered[-1], 6),
+        }
+
+    rounds_total = span_stats.get("round", {}).get("count", 0)
+    denom = max(1, rounds_total)
+    budget = {
+        "rounds_total": rounds_total,
+        "dispatches_total": events.get("dispatch", 0),
+        "dispatches_per_round": round(events.get("dispatch", 0) / denom, 6),
+        "host_syncs_total": events.get("host_sync", 0),
+        "host_syncs_per_round": round(events.get("host_sync", 0) / denom, 6),
+        "compile_events": compile_events,
+        "retrace_events": retrace_events,
+        "retraces_per_round": round(retrace_events / denom, 6),
+        "sent_mb_total": round(sent_mb, 6),
+        "received_mb_total": round(received_mb, 6),
+        "rejected_updates_total": rejected,
+        "dropped_clients_total": dropped,
+    }
+    return {
+        "meta": meta,
+        "records": len(records),
+        "spans": span_stats,
+        "events": events,
+        "programs": programs,
+        "budget": budget,
+    }
+
+
+def _budget_value(summary: dict, key: str) -> float:
+    budget = summary["budget"]
+    if key in budget:
+        return float(budget[key])
+    if key in summary["events"]:
+        return float(summary["events"][key])
+    raise TraceError(
+        f"unknown budget key {key!r} — known: "
+        f"{sorted(budget) + sorted(summary['events'])}"
+    )
+
+
+def check_budget(summary: dict, expressions: list[str]) -> list[str]:
+    """Evaluate ``key<op>value`` expressions against the summary; returns
+    the human-readable failures (empty = all budgets hold)."""
+    failures: list[str] = []
+    for expression in expressions:
+        match = _EXPR_RE.match(expression)
+        if match is None:
+            raise TraceError(
+                f"cannot parse budget expression {expression!r} "
+                "(expected e.g. 'dispatches_per_round<=1')"
+            )
+        actual = _budget_value(summary, match["key"])
+        try:
+            bound = float(match["value"])
+        except ValueError as exc:
+            raise TraceError(
+                f"cannot parse budget expression {expression!r}: "
+                f"{match['value']!r} is not a number"
+            ) from exc
+        if not _OPS[match["op"]](actual, bound):
+            failures.append(
+                f"budget violated: {match['key']}={actual:g} "
+                f"(required {match['op']} {bound:g})"
+            )
+    return failures
+
+
+def diff_summaries(candidate: dict, baseline: dict) -> dict[str, Any]:
+    """Per-budget-metric candidate-vs-baseline deltas plus the regression
+    list (a budget metric that INCREASED — e.g. the injected
+    +1-dispatch/round the PR 10 test pins)."""
+    deltas: dict[str, dict] = {}
+    regressions: list[str] = []
+    keys = sorted(set(candidate["budget"]) | set(baseline["budget"]))
+    for key in keys:
+        new = float(candidate["budget"].get(key, 0.0))
+        old = float(baseline["budget"].get(key, 0.0))
+        deltas[key] = {
+            "candidate": new,
+            "baseline": old,
+            "delta": round(new - old, 6),
+        }
+        if key in REGRESSION_KEYS and new > old + 1e-9:
+            regressions.append(
+                f"regression: {key} rose {old:g} -> {new:g} "
+                f"(+{new - old:g})"
+            )
+    return {"deltas": deltas, "regressions": regressions}
+
+
+def format_text(summary: dict) -> str:
+    lines = []
+    meta = summary.get("meta") or {}
+    if meta:
+        lines.append(
+            "trace: "
+            + " ".join(f"{k}={v}" for k, v in sorted(meta.items()))
+        )
+    lines.append(f"records: {summary['records']}")
+    if summary["spans"]:
+        lines.append("spans (seconds):")
+        header = f"  {'kind':<14}{'count':>7}{'p50':>10}{'p90':>10}{'max':>10}{'total':>11}"
+        lines.append(header)
+        for kind in sorted(summary["spans"]):
+            s = summary["spans"][kind]
+            lines.append(
+                f"  {kind:<14}{s['count']:>7}{s['p50_s']:>10.4f}"
+                f"{s['p90_s']:>10.4f}{s['max_s']:>10.4f}{s['total_s']:>11.4f}"
+            )
+    if summary["events"]:
+        lines.append(
+            "events: "
+            + " ".join(
+                f"{kind}={count}"
+                for kind, count in sorted(summary["events"].items())
+            )
+        )
+    if summary["programs"]:
+        lines.append(
+            "jit caches: "
+            + " ".join(
+                f"{name}={size}"
+                for name, size in sorted(summary["programs"].items())
+            )
+        )
+    budget = summary["budget"]
+    lines.append(
+        "budget: "
+        f"rounds={budget['rounds_total']} "
+        f"dispatches/round={budget['dispatches_per_round']:g} "
+        f"host_syncs/round={budget['host_syncs_per_round']:g} "
+        f"compiles={budget['compile_events']} "
+        f"retraces={budget['retrace_events']}"
+    )
+    lines.append(
+        "wire/faults: "
+        f"sent_mb={budget['sent_mb_total']:g} "
+        f"received_mb={budget['received_mb_total']:g} "
+        f"rejected_updates={budget['rejected_updates_total']:g} "
+        f"dropped_clients={budget['dropped_clients_total']:g}"
+    )
+    return "\n".join(lines)

@@ -1,0 +1,83 @@
+"""CLI: ``python -m tools.tracedump <trace.jsonl> [--diff baseline]
+[--format text|json] [--assert-budget EXPR]...``
+
+Exit status: 0 clean; 1 on a failed budget assertion or a diff
+regression; 2 on usage errors (see ``tools/tracedump/__init__.py``)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import (
+    TraceError,
+    check_budget,
+    diff_summaries,
+    format_text,
+    load_trace,
+    summarize,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.tracedump",
+        description="summarize/diff/budget-gate roundtrace JSONL traces"
+        " (docs/observability.md)",
+    )
+    parser.add_argument("trace", help="roundtrace JSONL file")
+    parser.add_argument(
+        "--diff",
+        metavar="BASELINE",
+        help="second trace to diff against; budget regressions"
+        " (dispatches/host-syncs/retraces per round increased) exit 1",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text"
+    )
+    parser.add_argument(
+        "--assert-budget",
+        action="append",
+        default=[],
+        metavar="EXPR",
+        help="budget expression like 'dispatches_per_round<=1'"
+        " (repeatable; any violation exits 1)",
+    )
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        summary = summarize(load_trace(args.trace))
+        failures = check_budget(summary, args.assert_budget)
+        diff = None
+        if args.diff:
+            diff = diff_summaries(summary, summarize(load_trace(args.diff)))
+            failures.extend(diff["regressions"])
+    except TraceError as exc:
+        print(f"tracedump: {exc}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        payload = dict(summary, budget_failures=failures)
+        if diff is not None:
+            payload["diff"] = diff
+        print(json.dumps(payload))
+    else:
+        print(format_text(summary))
+        if diff is not None:
+            print("diff vs baseline:")
+            for key, row in diff["deltas"].items():
+                if row["delta"]:
+                    print(
+                        f"  {key}: {row['baseline']:g} -> "
+                        f"{row['candidate']:g} ({row['delta']:+g})"
+                    )
+        for failure in failures:
+            print(failure, file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
