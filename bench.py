@@ -1002,6 +1002,100 @@ def measure_telemetry() -> dict:
     return out
 
 
+# buffered-asynchronous aggregation A/B (the FedBuff-style rounds): the
+# THREADED executor under a seeded straggler plan, barriered vs buffered.
+# Barriered rounds wait out every straggler sleep (the round barrier);
+# buffered flushes aggregate the on-time arrivals and let the straggler's
+# upload land one flush late with the staleness discount — the measured
+# wall-clock win is the whole point of the mode, reported as
+# buffered_speedup_fraction = 1 − buffered/barriered seconds per round
+# (a fraction, not a vibe).  staleness_p50 comes from the deterministic
+# arrival schedule both executors share (util/buffered.py).
+BUF_WORKERS = 4
+BUF_ROUNDS = 5
+BUF_BATCH = 16
+BUF_DELAY = 0.5
+
+
+def measure_buffered_aggregation() -> dict:
+    from distributed_learning_simulator_tpu.training import train
+    from distributed_learning_simulator_tpu.util.buffered import (
+        BufferedSettings,
+        compute_arrival_schedule,
+        threaded_uploaders,
+    )
+    from distributed_learning_simulator_tpu.util.faults import FaultPlan
+
+    fault_tolerance = {
+        "seed": 1,
+        # one consistently slow client — the canonical straggler story
+        "straggler_schedule": {
+            r: [BUF_WORKERS - 1] for r in range(1, BUF_ROUNDS + 1)
+        },
+        "straggler_delay_seconds": BUF_DELAY,
+    }
+    out: dict = {
+        "model": "LeNet5/MNIST",
+        "executor": "sequential",
+        "workers": BUF_WORKERS,
+        "rounds": BUF_ROUNDS,
+        "straggler_delay_seconds": BUF_DELAY,
+    }
+    config = None
+    for arm, algorithm_kwargs in (
+        ("barriered", {}),
+        (
+            "buffered",
+            {"aggregation_mode": "buffered", "staleness_alpha": 0.5},
+        ),
+    ):
+        config = make_config(
+            "sequential",
+            BUF_WORKERS,
+            BUF_WORKERS * BUF_BATCH * 2,
+            model_name="LeNet5",
+            batch_size=BUF_BATCH,
+            tag=f"buffered_{arm}",
+            dataset_name="MNIST",
+            rounds=BUF_ROUNDS,
+            use_amp=False,  # the canonical LeNet5/MNIST config is fp32
+            fault_tolerance=dict(fault_tolerance),
+            algorithm_kwargs=dict(algorithm_kwargs),
+        )
+        start = time.monotonic()
+        train(config)
+        elapsed = time.monotonic() - start
+        out[arm] = {
+            "seconds_total": round(elapsed, 4),
+            "seconds_per_round": round(elapsed / BUF_ROUNDS, 6),
+        }
+    barriered = out["barriered"]["seconds_per_round"]
+    buffered = out["buffered"]["seconds_per_round"]
+    if barriered > 0:
+        out["buffered_speedup_fraction"] = round(
+            1.0 - buffered / barriered, 4
+        )
+    # the deterministic schedule IS the staleness distribution — same
+    # population (LATE merges only: the trace emits one staleness event
+    # per late-merged update) and same percentile rule as tracedump's
+    # staleness block, so the two fields can never disagree
+    from tools.tracedump import _percentile
+
+    schedule = compute_arrival_schedule(
+        BufferedSettings.from_config(config),
+        FaultPlan.from_config(config),
+        BUF_WORKERS,
+        BUF_ROUNDS,
+        threaded_uploaders(config),
+    )
+    values = sorted(
+        float(v) for v in schedule.all_staleness() if v > 0
+    )
+    out["staleness_p50"] = _percentile(values, 0.50)
+    out["stale_updates_total"] = len(values)
+    return out
+
+
 def _tool_total_findings(module: str, timeout: float) -> int:
     """``python -m <module> --format json`` -> ``total_findings``.  A
     dirty exit (un-audited findings) still yields the count; only a
@@ -1102,6 +1196,15 @@ def main() -> None:
     # the -1/absent-never contract: the top-level field always prints; -1
     # means the measurement failed (same convention as lint_findings)
     dropout_overhead = fault_tolerance.get("dropout_overhead_fraction", -1.0)
+    # buffered-asynchronous aggregation A/B: threaded barriered vs
+    # buffered under injected stragglers — the wall-clock win of removing
+    # the round barrier, plus the schedule's staleness distribution
+    try:
+        buffered = measure_buffered_aggregation()
+    except Exception as exc:
+        buffered = {"error": str(exc)[:200]}
+    buffered_speedup = buffered.get("buffered_speedup_fraction", -1.0)
+    staleness_p50 = buffered.get("staleness_p50", -1.0)
     # roundtrace telemetry A/B: telemetry-on vs -off wall time on the
     # fused H=4 shape, plus the trace's own retrace count (0 = the
     # dispatch-budget invariant held at runtime)
@@ -1228,6 +1331,14 @@ def main() -> None:
                 # missing)
                 "dropout_overhead_fraction": dropout_overhead,
                 "fault_tolerance": fault_tolerance,
+                # buffered aggregation: the barrier-removal win on the
+                # threaded executor under injected stragglers (fraction
+                # of barriered wall time saved; -1 = the A/B failed, the
+                # fields never go missing) and the median staleness over
+                # every merged update in the deterministic schedule
+                "buffered_speedup_fraction": buffered_speedup,
+                "staleness_p50": staleness_p50,
+                "buffered_aggregation": buffered,
                 # roundtrace: telemetry-on must cost ~nothing (fraction ≈
                 # 0; -1 = the A/B failed, the fields never go missing)
                 # and the smoke trace must observe zero retraces

@@ -131,40 +131,12 @@ class AggregationAlgorithm:
     def _update_passes_guard(
         self, worker_id: int, message: ParameterMessage
     ) -> bool:
-        plan = self._fault_plan
-        if plan is None or not plan.update_guard:
-            return True
-        import numpy as np
-
-        norm_sq = 0.0
-        for name, value in message.parameter.items():
-            arr = np.asarray(value, np.float32)
-            if not np.all(np.isfinite(arr)):
-                get_logger().warning(
-                    "update guard: worker %s upload %r is non-finite — "
-                    "rejected",
-                    worker_id,
-                    name,
-                )
-                return False
-            if plan.max_update_norm > 0 and self._old_parameter_dict:
-                old = self._old_parameter_dict.get(name)
-                if old is not None:
-                    norm_sq += float(
-                        np.sum(
-                            np.square(arr - np.asarray(old, np.float32))
-                        )
-                    )
-        if plan.max_update_norm > 0 and norm_sq > plan.max_update_norm**2:
-            get_logger().warning(
-                "update guard: worker %s delta norm %.3e exceeds "
-                "max_update_norm=%.3e — rejected",
-                worker_id,
-                norm_sq**0.5,
-                plan.max_update_norm,
-            )
-            return False
-        return True
+        return update_passes_guard(
+            self._fault_plan,
+            worker_id,
+            message.parameter,
+            self._old_parameter_dict,
+        )
 
     def aggregate_worker_data(self) -> Message:
         raise NotImplementedError
@@ -176,6 +148,47 @@ class AggregationAlgorithm:
 
     def exit(self) -> None:
         pass
+
+
+def update_passes_guard(
+    plan, worker_id: int, parameter: Params, old_params: Params | None
+) -> bool:
+    """THE server-side update-hygiene check (module-level so the buffered
+    aggregation path can guard each flush item against its own ORIGIN
+    base — a stale delta's norm is measured from the global it trained
+    on, not the newest one): reject a non-finite upload, or one whose
+    delta norm vs ``old_params`` exceeds ``plan.max_update_norm``."""
+    if plan is None or not plan.update_guard:
+        return True
+    import numpy as np
+
+    norm_sq = 0.0
+    for name, value in parameter.items():
+        arr = np.asarray(value, np.float32)
+        if not np.all(np.isfinite(arr)):
+            get_logger().warning(
+                "update guard: worker %s upload %r is non-finite — "
+                "rejected",
+                worker_id,
+                name,
+            )
+            return False
+        if plan.max_update_norm > 0 and old_params:
+            old = old_params.get(name)
+            if old is not None:
+                norm_sq += float(
+                    np.sum(np.square(arr - np.asarray(old, np.float32)))
+                )
+    if plan.max_update_norm > 0 and norm_sq > plan.max_update_norm**2:
+        get_logger().warning(
+            "update guard: worker %s delta norm %.3e exceeds "
+            "max_update_norm=%.3e — rejected",
+            worker_id,
+            norm_sq**0.5,
+            plan.max_update_norm,
+        )
+        return False
+    return True
 
 
 def check_finite(params: Params) -> None:

@@ -19,6 +19,10 @@ from .driver import ObdRoundDriver
 
 
 class FedOBDServer(AggregationServer):
+    #: the OBD phase driver owns the round progression — a buffer flush
+    #: cannot reorder phase-1/phase-2 aggregates (aggregation_mode gate)
+    _buffered_capable = False
+
     def __init__(self, **kwargs: Any) -> None:
         kwargs.setdefault("algorithm", FedAVGAlgorithm())
         super().__init__(**kwargs)

@@ -12,6 +12,10 @@ from .shapley_value_algorithm import ShapleyValueAlgorithm
 
 
 class ShapleyValueServer(AggregationServer):
+    #: Shapley subset sampling needs every selected upload per round — a
+    #: staleness-discounted partial flush has no valuation semantics
+    _buffered_capable = False
+
     def __init__(self, *args: Any, **kwargs: Any) -> None:
         super().__init__(*args, **kwargs)
         self.need_init_performance = True

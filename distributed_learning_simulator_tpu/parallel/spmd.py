@@ -230,7 +230,15 @@ def scan_local_epochs_carry(
     )
 
 
-def guard_client_update(params, global_params, weight, summed, max_update_norm):
+def guard_client_update(
+    params,
+    global_params,
+    weight,
+    summed,
+    max_update_norm,
+    sharded=None,
+    reduce_axis=None,
+):
     """THE device-side update-hygiene check, shared by the FedAvg round
     program and the OBD phase programs (one definition — the guard
     semantics must never drift between methods): reject a client whose
@@ -240,15 +248,50 @@ def guard_client_update(params, global_params, weight, summed, max_update_norm):
     summed')`` — the rejected slot's effective weight is exactly zero, and
     the per-slot reject flag plus the effective weight ride the metrics
     tree (``_eff_weight`` is popped by the shard bodies to form the
-    survivor-renormalized total weight)."""
-    finite = jnp.bool_(True)
-    norm_sq = jnp.float32(0.0)
-    for p, g in zip(
-        jax.tree.leaves(params), jax.tree.leaves(global_params)
-    ):
-        delta = p.astype(jnp.float32) - g.astype(jnp.float32)
-        finite = finite & jnp.all(jnp.isfinite(delta))
-        norm_sq = norm_sq + jnp.sum(jnp.square(delta))
+    survivor-renormalized total weight).
+
+    ``reduce_axis`` is the cross-stage flavor (the pipeline session):
+    inside its shard_map the ``sharded`` leaves (the stacked trunk) are
+    per-STAGE local slices, so each stage guards its OWN slice — local
+    non-finite count and local norm contribution — and the verdict is
+    all-reduced along the axis (``psum`` of the slice stats; replicated
+    leaves are counted once).  Every stage then derives the IDENTICAL
+    effective weight, which is exactly the consistency the old pipeline
+    carve-out could not provide, with the same global-delta semantics as
+    the client-axis guard."""
+    if reduce_axis is None:
+        finite = jnp.bool_(True)
+        norm_sq = jnp.float32(0.0)
+        for p, g in zip(
+            jax.tree.leaves(params), jax.tree.leaves(global_params)
+        ):
+            delta = p.astype(jnp.float32) - g.astype(jnp.float32)
+            finite = finite & jnp.all(jnp.isfinite(delta))
+            norm_sq = norm_sq + jnp.sum(jnp.square(delta))
+    else:
+        sharded = sharded or {}
+        local_nonfinite = jnp.float32(0.0)
+        local_norm = jnp.float32(0.0)
+        repl_finite = jnp.bool_(True)
+        repl_norm = jnp.float32(0.0)
+        for key in params:
+            delta = params[key].astype(jnp.float32) - global_params[
+                key
+            ].astype(jnp.float32)
+            if sharded.get(key):
+                # stage-local slice: contribute this stage's share
+                local_nonfinite = local_nonfinite + jnp.sum(
+                    jnp.where(jnp.isfinite(delta), 0.0, 1.0)
+                )
+                local_norm = local_norm + jnp.sum(jnp.square(delta))
+            else:
+                # replicated leaf: identical on every stage, count once
+                repl_finite = repl_finite & jnp.all(jnp.isfinite(delta))
+                repl_norm = repl_norm + jnp.sum(jnp.square(delta))
+        norm_sq = jax.lax.psum(local_norm, reduce_axis) + repl_norm
+        finite = (
+            jax.lax.psum(local_nonfinite, reduce_axis) == 0
+        ) & repl_finite
     ok = finite & jnp.isfinite(weight)
     if max_update_norm > 0:
         ok = ok & (norm_sq <= jnp.float32(max_update_norm) ** 2)
@@ -314,6 +357,8 @@ def scan_weighted_clients(
     val_data=None,
     guard_active: bool = False,
     max_update_norm: float = 0.0,
+    guard_sharded=None,
+    guard_reduce_axis=None,
 ):
     """Clients one after another as a ``lax.scan`` (the round body of the
     whole-mesh-per-client sessions, ``spmd_sp.py``/``spmd_ep.py``), with
@@ -329,7 +374,9 @@ def scan_weighted_clients(
     SURVIVORS alongside the params, a zero-survivor round keeps the old
     global (:func:`guarded_average`), and the summed metrics gain the
     ``rejected_updates`` count — the same semantics the client-axis
-    shard bodies compile in."""
+    shard bodies compile in.  ``guard_sharded``/``guard_reduce_axis``
+    select the cross-stage guard flavor (the pipeline session: per-stage
+    slice stats all-reduced along ``pp`` — :func:`guard_client_update`)."""
 
     def body(acc, xs):
         cdata, cval, weight, rng = xs
@@ -345,7 +392,13 @@ def scan_weighted_clients(
         if guard_active:
             acc_params, acc_metrics, acc_w, acc_rej = acc
             weight, summed = guard_client_update(
-                params, global_params, weight, summed, max_update_norm
+                params,
+                global_params,
+                weight,
+                summed,
+                max_update_norm,
+                sharded=guard_sharded,
+                reduce_axis=guard_reduce_axis,
             )
             acc_w = acc_w + summed.pop("_eff_weight")
             acc_rej = acc_rej + summed.pop("rejected_updates")
@@ -609,6 +662,60 @@ class SpmdFedAvgSession(TraceCounterMixin):
         #: earliest FaultPlan kill round reached but not yet fired —
         #: kills only fire once the killed round is durably resumable
         self._kill_armed_round: int | None = None
+        # ---- buffered-asynchronous aggregation (util/buffered.py) ----
+        # ``aggregation_mode: buffered`` replays the deterministic arrival
+        # schedule the threaded executor's buffer flushes follow: each
+        # round trains the SAME cohort it does today, but a straggling
+        # client's contribution is routed into a pending ring that merges
+        # at its landing flush with the staleness discount folded into the
+        # host-built weight rows (the PR 7 trick — no per-round host
+        # syncs, ≤ 1 dispatch/round, fuses with gather and round-horizon).
+        # With no stragglers and no buffer overflow the schedule is
+        # depth-0 and the session traces the UNCHANGED synchronous
+        # programs — bit-exact (pinned).
+        from ..util.buffered import BufferedSettings
+
+        self._buffered = BufferedSettings.from_config(config)
+        self._arrival_schedule = None
+        self._buffered_depth = 0
+        if self._buffered is not None:
+            buffered_reason = self._buffered_unsupported_reason()
+            if buffered_reason is not None:
+                raise ValueError(
+                    "algorithm_kwargs.aggregation_mode=buffered is"
+                    f" unsupported here: {buffered_reason} — drop the knob"
+                    " for this session"
+                )
+            from ..util.buffered import (
+                compute_arrival_schedule,
+                selection_uploaders,
+            )
+
+            self._arrival_schedule = compute_arrival_schedule(
+                self._buffered,
+                self._fault_plan,
+                config.worker_number,
+                config.round,
+                selection_uploaders(config),
+            )
+            self._buffered_depth = self._arrival_schedule.max_staleness
+        #: whether the buffered round programs are actually traced — a
+        #: depth-0 schedule (no stragglers, no overflow) degenerates to
+        #: the synchronous programs, bit-exactly
+        self._buffered_active = self._buffered_depth > 0
+        #: device pending ring (buffered): (f32 sums tree with a leading
+        #: [depth] dim, [depth] weight totals) — the updates trained but
+        #: not yet landed, carried donated round over round
+        self._pending = None
+        self._round_delays = None  # device [S] delay row for the dispatch
+        self._horizon_delay_rows = None  # device [H, S] rows under fusion
+        self._buffered_program_fn = None
+        self._buffered_gather_program_fn = None
+        #: origins below this are pre-resume phantoms: their pending
+        #: contributions died with the killed process, so cohort
+        #: accounting and the flush quorum must not count them ("resume
+        #: drains the buffer" — the threaded server keeps the same floor)
+        self._buffered_origin_floor = 1
         # round-horizon fusion (``algorithm_kwargs.round_horizon``): fuse H
         # consecutive rounds into ONE jitted, donated ``lax.scan`` over
         # rounds, with per-round test evaluation in-program — the host
@@ -802,9 +909,25 @@ class SpmdFedAvgSession(TraceCounterMixin):
 
     @classmethod
     def _class_update_guard_reason(cls) -> str | None:
-        """Class-level update-guard gate (the pipeline session overrides
-        with its per-stage carve-out)."""
+        """Class-level update-guard gate (every fusable layout supports
+        the guard since the pipeline session grew its cross-stage verdict
+        reduction)."""
         return cls._bespoke_round_program_reason()
+
+    @classmethod
+    def _class_buffered_reason(cls) -> str | None:
+        """Class-level ``aggregation_mode: buffered`` gate: the buffered
+        replay (pending-ring round programs) is implemented on the
+        client-axis FedAvg family (fed_avg / fed_paq); every other
+        session still runs round-barriered and must reject the knob
+        loudly instead of silently dropping it."""
+        if cls is not SpmdFedAvgSession:
+            return (
+                "buffered aggregation (aggregation_mode: buffered) is"
+                " implemented on the client-axis FedAvg family;"
+                f" {cls.__name__} still runs round-barriered"
+            )
+        return None
 
     @classmethod
     def capability_gates(cls) -> dict[str, str | None]:
@@ -817,6 +940,7 @@ class SpmdFedAvgSession(TraceCounterMixin):
             "round_horizon": cls._horizon_unsupported_reason(),
             "selection_gather": cls._bespoke_round_program_reason(),
             "update_guard": cls._class_update_guard_reason(),
+            "aggregation_mode": cls._class_buffered_reason(),
         }
 
     def _selection_gather_unsupported_reason(self) -> str | None:
@@ -846,6 +970,21 @@ class SpmdFedAvgSession(TraceCounterMixin):
         into its round program (None = supported) — delegates to the
         class-level gate shared with the conf validator."""
         return self._class_update_guard_reason()
+
+    def _buffered_unsupported_reason(self) -> str | None:
+        """Why this session cannot run buffered-asynchronous aggregation
+        (None = supported): the class-level gate plus instance-state
+        fallbacks (FSDP's population-shaped all-gather/reduce_scatter
+        layout has no replicated pending-ring home)."""
+        reason = self._class_buffered_reason()
+        if reason is not None:
+            return reason
+        if self._fsdp:
+            return (
+                "FSDP model sharding stores params in the dense slot"
+                " layout; the buffered pending ring is replicated-only"
+            )
+        return None
 
     def _round_mesh_context(self):
         """Ambient-mesh context wrapping every program trace/dispatch —
@@ -891,6 +1030,16 @@ class SpmdFedAvgSession(TraceCounterMixin):
         check costs nothing extra."""
         if not self._update_guard:
             return
+        if self._buffered_active:
+            # buffered replay: this round's in-program rejects belong to
+            # the flushes their contributions were SCHEDULED to land in
+            # (a rejected straggler thins a later flush), so subtracting
+            # them from this round's flush cohort would abort the wrong
+            # round.  The explicit flush-cohort quorum is enforced
+            # pre-dispatch by _buffered_flush_quorum (corrupt-aware), and
+            # an all-rejected flush keeps the old params — a well-defined
+            # no-op, not a degenerate aggregate.
+            return
         survivors = int(participating) - int(rejected)
         quorum = max(self._min_quorum, 1)
         if survivors < quorum:
@@ -904,6 +1053,42 @@ class SpmdFedAvgSession(TraceCounterMixin):
             )
             get_logger().error(message)
             raise QuorumLostError(message)
+
+    def _buffered_round_extras(self, round_number: int) -> dict:
+        """Per-flush stat columns + telemetry for the buffered replay —
+        every value is host schedule state, zero device touches.  Emits
+        one ``staleness`` event per late-merged update and a
+        ``buffer_flush`` event per flush (the threaded executor's
+        ``buffer_flush`` SPAN measures a real wall-clock window; the
+        replay's flush IS the round, so an event carries the counts)."""
+        schedule = self._arrival_schedule
+        floor = self._buffered_origin_floor
+        cohort = schedule.live_cohort(round_number, floor)
+        stale = schedule.stale_count(round_number, floor)
+        backlog = schedule.buffer_depth_after(round_number, floor)
+        if self._trace.enabled:
+            for item in cohort:
+                if item.staleness:
+                    self._trace.event(
+                        "staleness",
+                        round=round_number,
+                        worker=item.worker,
+                        origin=item.origin,
+                        staleness=item.staleness,
+                        discount=round(item.discount, 6),
+                    )
+            self._trace.event(
+                "buffer_flush",
+                round=round_number,
+                cohort=len(cohort),
+                stale_updates=stale,
+                buffer_depth=backlog,
+            )
+        return {
+            "flush_cohort": len(cohort),
+            "stale_updates": stale,
+            "buffer_depth": backlog,
+        }
 
     def _leaf_spec(self, shape, name: str = "") -> P:
         """FSDP layout rule: shard a param leaf's leading dim over the
@@ -1128,7 +1313,181 @@ class SpmdFedAvgSession(TraceCounterMixin):
                 out_specs=(self._param_specs, P()),
             )(global_params, data, val, weights, rngs)
 
-        return self._wrap_round_programs(round_program)
+        sync_fn = self._wrap_round_programs(round_program)
+        if not self._buffered_active:
+            return sync_fn
+
+        # ---- buffered replay twin (aggregation_mode: buffered) ----
+        # The SAME per-client training (local_train — quant codec and
+        # update guard included), but each slot's weighted contribution is
+        # ROUTED by its host-scheduled staleness instead of merging into
+        # this round's average: bucket k collects the contributions
+        # landing k flushes from now.  Bucket 0 + the pending ring's head
+        # form this flush; buckets 1..D refill the ring.  The synchronous
+        # program above is traced unchanged, so aggregation_mode off (or a
+        # depth-0 schedule) stays bit-exact.
+        depth = self._buffered_depth
+
+        def buffered_one(global_params, data, weight, onehot, rng, val):
+            contribution, summed = local_train(
+                global_params, data, weight, rng, val
+            )
+            eff_weight = (
+                summed["_eff_weight"] if guard_active else weight
+            )
+            route = onehot > 0  # [depth+1] — exactly one True
+            # where(), not multiply: a NaN-poisoned contribution (corrupt
+            # injection without the guard) must stay confined to ITS
+            # bucket — 0 * NaN would leak it into every bucket
+            bucket_contrib = jax.tree.map(
+                lambda c: jnp.where(
+                    route.reshape((depth + 1,) + (1,) * c.ndim),
+                    c[None],
+                    jnp.float32(0.0),
+                ),
+                contribution,
+            )
+            bucket_weight = jnp.where(route, eff_weight, jnp.float32(0.0))
+            if guard_active:
+                summed = dict(summed)
+                summed.pop("_eff_weight")
+            return bucket_contrib, bucket_weight, summed
+
+        def buffered_shard_body(global_params, data, val, weights, delays, rngs):
+            slots_local = weights.shape[0]
+            mb = chunk_size(slots_local)
+            onehot = jax.nn.one_hot(delays, depth + 1, dtype=jnp.float32)
+
+            def run_slots(d, w, oh, r, v):
+                return jax.vmap(
+                    buffered_one, in_axes=(None, 0, 0, 0, 0, 0)
+                )(global_params, d, w, oh, r, v if v else None)
+
+            if mb == slots_local:
+                contribs, wvecs, metrics = run_slots(
+                    data, weights, onehot, rngs, val
+                )
+                bucket_sums = jax.tree.map(
+                    lambda c: jnp.sum(c, axis=0), contribs
+                )
+                bucket_weights = jnp.sum(wvecs, axis=0)
+                metrics = jax.tree.map(lambda m: jnp.sum(m), metrics)
+            else:
+                n_chunks = slots_local // mb
+
+                def to_chunks(tree):
+                    return jax.tree.map(
+                        lambda x: x.reshape(n_chunks, mb, *x.shape[1:]),
+                        tree,
+                    )
+
+                def chunk_body(acc, chunk):
+                    data_k, v_k, w_k, oh_k, r_k = chunk
+                    contrib, wvec, met = run_slots(
+                        data_k, w_k, oh_k, r_k, v_k
+                    )
+                    acc_sum, acc_w, acc_met = acc
+                    acc_sum = jax.tree.map(
+                        lambda a, c: a + jnp.sum(c, axis=0), acc_sum, contrib
+                    )
+                    acc_w = acc_w + jnp.sum(wvec, axis=0)
+                    acc_met = jax.tree.map(
+                        lambda a, m: a + jnp.sum(m), acc_met, met
+                    )
+                    return (acc_sum, acc_w, acc_met), None
+
+                chunks = (
+                    to_chunks(data),
+                    to_chunks(val),
+                    to_chunks(weights),
+                    to_chunks(onehot),
+                    to_chunks(rngs),
+                )
+                _, _, met_shapes = jax.eval_shape(
+                    lambda d, v, w, oh, r: run_slots(d, w, oh, r, v),
+                    *jax.tree.map(lambda x: x[0], chunks),
+                )
+                init = (
+                    jax.tree.map(
+                        lambda p: jnp.zeros(
+                            (depth + 1, *p.shape), jnp.float32
+                        ),
+                        global_params,
+                    ),
+                    jnp.zeros((depth + 1,), jnp.float32),
+                    jax.tree.map(
+                        lambda s: jnp.zeros((), s.dtype), met_shapes
+                    ),
+                )
+                (bucket_sums, bucket_weights, metrics), _ = jax.lax.scan(
+                    chunk_body, init, chunks
+                )
+            bucket_sums = jax.tree.map(
+                lambda s: jax.lax.psum(s, axis_name="clients"), bucket_sums
+            )
+            bucket_weights = jax.lax.psum(bucket_weights, axis_name="clients")
+            metrics = jax.tree.map(
+                lambda m: jax.lax.psum(jnp.sum(m), axis_name="clients"),
+                metrics,
+            )
+            return bucket_sums, bucket_weights, metrics
+
+        replicated_out = {k: P() for k in self._param_specs}
+
+        def buffered_round_program(
+            global_params, pending, weights, delays, rngs, data, val
+        ):
+            bucket_sums, bucket_weights, metrics = shard_map_compat(
+                buffered_shard_body,
+                self.mesh,
+                in_specs=(
+                    self._param_specs,
+                    self._slot_spec,
+                    self._slot_spec,
+                    self._slot_spec,
+                    self._slot_spec,
+                    self._slot_spec,
+                ),
+                out_specs=(replicated_out, P(), P()),
+            )(global_params, data, val, weights, delays, rngs)
+            pend_sums, pend_weights = pending
+            flush_sum = jax.tree.map(
+                lambda b, p: b[0] + p[0], bucket_sums, pend_sums
+            )
+            flush_weight = bucket_weights[0] + pend_weights[0]
+            # an empty flush (every arrival stale) keeps the old global —
+            # the buffered analogue of guarded_average's zero-survivor
+            # rule.  Selected on `== 0` (not `> 0`) so a NaN-poisoned
+            # flush weight (corrupt injection WITHOUT the guard) divides
+            # through and poisons the aggregate VISIBLY, exactly like the
+            # synchronous paths — never a silent keep-old swallow.
+            new_global = jax.tree.map(
+                lambda s, old: jnp.where(
+                    flush_weight == 0,
+                    old,
+                    (s / jnp.maximum(flush_weight, 1e-12)).astype(
+                        old.dtype
+                    ),
+                ),
+                flush_sum,
+                global_params,
+            )
+            # ring shift: tomorrow's head is bucket 1 + pending slot 1
+            new_pend_sums = jax.tree.map(
+                lambda b, p: b[1:]
+                + jnp.concatenate([p[1:], jnp.zeros_like(p[:1])]),
+                bucket_sums,
+                pend_sums,
+            )
+            new_pend_weights = bucket_weights[1:] + jnp.concatenate(
+                [pend_weights[1:], jnp.zeros_like(pend_weights[:1])]
+            )
+            return (
+                new_global,
+                (new_pend_sums, new_pend_weights),
+            ), metrics
+
+        return self._wrap_buffered_programs(buffered_round_program)
 
     def _wrap_round_programs(self, round_program, out_shardings=None):
         """The shared tail of every fusable ``_build_round_fn`` (the base
@@ -1229,6 +1588,134 @@ class SpmdFedAvgSession(TraceCounterMixin):
 
         return fn
 
+    def _wrap_buffered_programs(self, buffered_round_program):
+        """The buffered twin of :meth:`_wrap_round_programs`: register the
+        un-jitted ``(global_params, pending, weights, delays, rngs, data,
+        val)`` program for the buffered horizon builder, jit it (params
+        AND the pending ring donated, both pinned to their stored layouts
+        so the round-over-round carries never reshard), build the gather
+        twin, and return a dispatch fn with the SYNC dispatch signature —
+        the run loop stays oblivious: the delay row and the pending ring
+        ride session state set by ``_prepare_round_inputs``."""
+        self._buffered_program_fn = buffered_round_program
+        out_pin = ((self._param_shardings, self._replicated), None)
+        jitted = jax.jit(
+            buffered_round_program,
+            donate_argnums=(0, 1),
+            out_shardings=out_pin,
+        )
+        self._jitted_buffered_round_fn = jitted
+        jitted_gather = None
+        if self._selection_gather:
+            session = self
+
+            def buffered_gather_program(
+                global_params, pending, weights, delays, rngs, sel_idx,
+                data, val,
+            ):
+                """The buffered program over a gathered ``[s_pad]`` slot
+                stack — same constrained device-side take as the sync
+                gather twin."""
+
+                def take(tree, stored):
+                    shardings = jax.tree.map(lambda x: x.sharding, stored)
+                    return jax.tree.map(
+                        lambda x, s: jax.lax.with_sharding_constraint(
+                            jnp.take(x, sel_idx, axis=0), s
+                        ),
+                        tree,
+                        shardings,
+                    )
+
+                return buffered_round_program(
+                    global_params,
+                    pending,
+                    weights,
+                    delays,
+                    rngs,
+                    take(data, session._data),
+                    take(val, session._val_data or {}),
+                )
+
+            self._buffered_gather_program_fn = buffered_gather_program
+            jitted_gather = jax.jit(
+                buffered_gather_program,
+                donate_argnums=(0, 1),
+                out_shardings=out_pin,
+            )
+            self._jitted_buffered_gather_fn = jitted_gather
+
+        def fn(global_params, weights, rngs, sel_idx=None):
+            pending = self._ensure_pending()
+            delays = self._round_delays
+            with self._round_mesh_context():
+                if sel_idx is not None:
+                    (new_global, self._pending), metrics = (
+                        self._trace.dispatch(
+                            "round[buffered-gather]",
+                            jitted_gather,
+                            (
+                                global_params,
+                                pending,
+                                weights,
+                                delays,
+                                rngs,
+                                sel_idx,
+                                self._data,
+                                self._val_data or {},
+                            ),
+                            sig_args=(weights, delays, rngs, sel_idx),
+                        )
+                    )
+                else:
+                    (new_global, self._pending), metrics = (
+                        self._trace.dispatch(
+                            "round[buffered]",
+                            jitted,
+                            (
+                                global_params,
+                                pending,
+                                weights,
+                                delays,
+                                rngs,
+                                self._data,
+                                self._val_data or {},
+                            ),
+                            sig_args=(weights, delays, rngs),
+                        )
+                    )
+            return new_global, metrics
+
+        return fn
+
+    def _ensure_pending(self) -> tuple:
+        """The device pending ring, zero-initialized on first use (and
+        after a resume: in-flight updates at a kill are DROPPED, like a
+        real buffered deployment restart — docs/migrating.md "Buffered
+        aggregation").  The trailing copy keeps the donated buffers
+        XLA-owned (the _place_params rule)."""
+        if self._pending is None:
+            depth = self._buffered_depth
+            template = jax.eval_shape(
+                lambda: self.engine.init_params(self.config.seed)
+            )
+            sums = {
+                k: jnp.copy(
+                    jax.device_put(
+                        jnp.zeros((depth, *v.shape), jnp.float32),
+                        self._replicated,
+                    )
+                )
+                for k, v in template.items()
+            }
+            weights = jnp.copy(
+                jax.device_put(
+                    jnp.zeros((depth,), jnp.float32), self._replicated
+                )
+            )
+            self._pending = (sums, weights)
+        return self._pending
+
     # ------------------------------------------------------------------
     def _build_horizon_fn(self, horizon: int):
         """``horizon`` consecutive rounds as ONE jitted, donated
@@ -1238,6 +1725,8 @@ class SpmdFedAvgSession(TraceCounterMixin):
         in-program, runs the SAME round program the per-round path jits,
         and evaluates the fresh global on the device-resident test batches
         — stacked ``[H, ...]`` metrics come back in one host fetch."""
+        if self._buffered_active:
+            return self._build_buffered_horizon_fn(horizon)
         engine = self.engine
         n_slots = self.n_slots
         round_program = self._round_program_fn
@@ -1313,6 +1802,104 @@ class SpmdFedAvgSession(TraceCounterMixin):
         fn._jitted = jitted
         return fn
 
+    def _build_buffered_horizon_fn(self, horizon: int):
+        """The buffered twin of :meth:`_build_horizon_fn`: the scan carry
+        additionally threads the pending ring, so a straggler's
+        contribution trained in chunk ``i`` can land in chunk ``i`` or
+        ``i+1`` — the ring crosses horizon boundaries through the donated
+        carry exactly like the params do.  Scanned inputs gain the
+        ``[H, S]`` staleness-delay rows next to the weight rows; still one
+        dispatch and one stacked-metrics sync per horizon."""
+        engine = self.engine
+        n_slots = self.n_slots
+        buffered_program = self._buffered_program_fn
+        gather_program = self._buffered_gather_program_fn
+        use_gather = self._selection_gather
+        with_confusion = bool(self.config.use_slow_performance_metrics)
+
+        def horizon_program(
+            global_params,
+            pending,
+            rng,
+            weight_rows,
+            delay_rows,
+            idx_rows,
+            data,
+            val,
+            eval_batches,
+        ):
+            def body(carry, xs):
+                params, pending, rng = carry
+                rng, round_rng = jax.random.split(rng)
+                if use_gather:
+                    weights, delays, sel_idx = xs
+                    client_rngs = jax.vmap(
+                        lambda i: jax.random.fold_in(round_rng, i)
+                    )(sel_idx)
+                    (params, pending), train_metrics = gather_program(
+                        params, pending, weights, delays, client_rngs,
+                        sel_idx, data, val,
+                    )
+                else:
+                    weights, delays = xs
+                    client_rngs = jax.vmap(
+                        lambda i: jax.random.fold_in(round_rng, i)
+                    )(jnp.arange(n_slots))
+                    (params, pending), train_metrics = buffered_program(
+                        params, pending, weights, delays, client_rngs,
+                        data, val,
+                    )
+                eval_summed = engine.eval_fn(params, eval_batches)
+                outs = (train_metrics, eval_summed)
+                if with_confusion:
+                    outs = outs + (engine.confusion_fn(params, eval_batches),)
+                return (params, pending, rng), outs
+
+            xs = (
+                (weight_rows, delay_rows, idx_rows)
+                if use_gather
+                else (weight_rows, delay_rows)
+            )
+            (global_params, pending, rng), outs = jax.lax.scan(
+                body, (global_params, pending, rng), xs, length=horizon
+            )
+            return (global_params, pending, rng), outs
+
+        jitted = jax.jit(
+            horizon_program,
+            donate_argnums=(0, 1, 2),
+            out_shardings=(
+                (self._param_shardings, self._replicated, None),
+                None,
+            ),
+        )
+
+        def fn(global_params, rng, weight_rows, idx_rows=None):
+            pending = self._ensure_pending()
+            delay_rows = self._horizon_delay_rows
+            with self._round_mesh_context():
+                (global_params, pending, rng), outs = self._trace.dispatch(
+                    f"horizon[buffered,h={horizon}]",
+                    jitted,
+                    (
+                        global_params,
+                        pending,
+                        rng,
+                        weight_rows,
+                        delay_rows,
+                        idx_rows,
+                        self._data,
+                        self._val_data or {},
+                        self._ensure_eval_batches(),
+                    ),
+                    sig_args=(weight_rows, delay_rows, idx_rows),
+                )
+            self._pending = pending
+            return (global_params, rng), outs
+
+        fn._jitted = jitted
+        return fn
+
     def round_flops(self, global_params) -> float:
         """Analytic FLOP count for ONE round (bench MFU): XLA's cost
         analysis of a single un-scanned train step × steps per round.
@@ -1347,8 +1934,11 @@ class SpmdFedAvgSession(TraceCounterMixin):
             return 0.0
 
     # ------------------------------------------------------------------
-    def _select_weights(self, round_number: int) -> np.ndarray:
-        from ..util.faults import apply_fault_plan
+    def _base_weight_row(self, round_number: int) -> np.ndarray:
+        """The dense ``[n_slots]`` pre-fault selection row (slot = worker
+        id, dataset-size weights) — ONE definition of the selection /
+        slot-order contract shared by the synchronous fault fold and the
+        buffered schedule fold."""
         from ..utils.selection import select_workers
 
         selected = select_workers(
@@ -1360,27 +1950,16 @@ class SpmdFedAvgSession(TraceCounterMixin):
         weights = np.zeros(self.n_slots, np.float32)
         for worker_id in selected:
             weights[worker_id] = self._dataset_sizes[worker_id]
-        # fold the round's availability mask into the weight row (dropped
-        # → 0, corrupt → NaN) and enforce the quorum — a no-op without a
-        # fault plan, so the unfaulted trajectory is bit-exact
-        return apply_fault_plan(
-            self._fault_plan,
-            self._min_quorum,
-            round_number,
-            None,
-            weights,
-            self.config.worker_number,
-        )
+        return weights
 
-    def _select_indices(
+    def _base_index_rows(
         self, round_number: int
     ) -> tuple[np.ndarray, np.ndarray]:
-        """Host-side selection for the gather path: the round's selected
-        worker ids (ascending — the dense path's slot order, so the
-        weighted reduction sees the contributions in the same order) padded
-        to the static ``s_pad`` with id 0 at weight 0, plus their
-        aggregation weights."""
-        from ..util.faults import apply_fault_plan
+        """The gather-path pre-fault rows: the round's selected worker
+        ids (ascending — the dense path's slot order, so the weighted
+        reduction sees the contributions in the same order) padded to the
+        static ``s_pad`` with id 0 at weight 0, plus their weights —
+        shared by both fault-fold flavors like :meth:`_base_weight_row`."""
         from ..utils.selection import select_workers
 
         selected = sorted(
@@ -1395,10 +1974,34 @@ class SpmdFedAvgSession(TraceCounterMixin):
         idx[: len(selected)] = selected
         weights = np.zeros(self.s_pad, np.float32)
         weights[: len(selected)] = self._dataset_sizes[selected]
-        # dropped ids are masked out of the S_pad row (weight 0 — they
-        # still occupy a gathered slot but contribute exact zeros, like
-        # padding); same draw as the dense path, so gather/dense parity
-        # holds under injection too
+        return idx, weights
+
+    def _select_weights(self, round_number: int) -> np.ndarray:
+        from ..util.faults import apply_fault_plan
+
+        # fold the round's availability mask into the weight row (dropped
+        # → 0, corrupt → NaN) and enforce the quorum — a no-op without a
+        # fault plan, so the unfaulted trajectory is bit-exact
+        return apply_fault_plan(
+            self._fault_plan,
+            self._min_quorum,
+            round_number,
+            None,
+            self._base_weight_row(round_number),
+            self.config.worker_number,
+        )
+
+    def _select_indices(
+        self, round_number: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Gather-path selection: :meth:`_base_index_rows` with the fault
+        mask folded in.  Dropped ids are masked out of the S_pad row
+        (weight 0 — they still occupy a gathered slot but contribute
+        exact zeros, like padding); same draw as the dense path, so
+        gather/dense parity holds under injection too."""
+        from ..util.faults import apply_fault_plan
+
+        idx, weights = self._base_index_rows(round_number)
         weights = apply_fault_plan(
             self._fault_plan,
             self._min_quorum,
@@ -1409,11 +2012,136 @@ class SpmdFedAvgSession(TraceCounterMixin):
         )
         return idx, weights
 
+    # -------------------------------------------- buffered replay rows
+    def _fold_buffered_schedule(
+        self, round_number: int, ids, weights: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """The buffered twin of :func:`~.faults.apply_fault_plan`: fold
+        the arrival schedule into one TRAINING round's host weight row —
+        a landing update's weight is pre-discounted by its scheduled
+        staleness (the contribution is formed at train time, so the
+        discount must ride the training-round row), a never-landing
+        update (dropped, or landing past the run's end) is zeroed, and a
+        corrupt one is NaN'd at its landing bucket.  Returns ``(weights,
+        delays)`` — ``delays[pos]`` routes the slot's contribution into
+        the pending ring.  No straggler sleep: the replay runs in logical
+        time (the threaded executor is where wall-clock skew is real)."""
+        from ..util.buffered import staleness_discount
+
+        schedule = self._arrival_schedule
+        delays = np.zeros(len(weights), np.int32)
+        plan = self._fault_plan
+        corrupt = (
+            plan.corrupt_clients(round_number, self.config.worker_number)
+            if plan is not None and plan.injection_active
+            else frozenset()
+        )
+        worker_ids = (
+            np.asarray(ids) if ids is not None else np.arange(len(weights))
+        )
+        for pos, wid in enumerate(worker_ids):
+            if not weights[pos]:
+                continue  # unselected / padding slot
+            delay = schedule.delay(int(wid), round_number)
+            if delay is None:
+                weights[pos] = 0.0  # lost upload, or lands past run end
+                continue
+            delays[pos] = delay
+            if int(wid) in corrupt:
+                weights[pos] = np.nan
+            else:
+                weights[pos] = np.float32(
+                    float(weights[pos])
+                    * staleness_discount(
+                        delay, self._buffered.staleness_alpha
+                    )
+                )
+        return weights, delays
+
+    def _buffered_flush_quorum(self, round_number: int) -> None:
+        """Buffered quorum: an EXPLICIT ``min_client_quorum`` is enforced
+        against the round's flush cohort (what actually aggregates), not
+        the training cohort.  The implicit floor-of-1 the synchronous
+        fault machinery applies does NOT hold here — an empty flush is a
+        well-defined keep-the-old-params round (every arrival was stale),
+        not a degenerate aggregate."""
+        if self._min_quorum <= 0:
+            return
+        plan = self._fault_plan
+        cohort = self._arrival_schedule.live_cohort(
+            round_number, self._buffered_origin_floor
+        )
+        survivors = sum(
+            1
+            for item in cohort
+            if plan is None
+            or item.worker
+            not in plan.corrupt_clients(
+                item.origin, self.config.worker_number
+            )
+        )
+        if survivors < self._min_quorum:
+            from ..util.faults import QuorumLostError
+
+            message = (
+                f"flush {round_number}: {survivors} surviving buffered"
+                f" arrivals below min_client_quorum={self._min_quorum} —"
+                " aborting the round loudly"
+            )
+            get_logger().error(message)
+            raise QuorumLostError(message)
+
+    def _buffered_select_weights(
+        self, round_number: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Dense-path ``(weights, delays)`` rows under buffered replay:
+        the SAME base selection row as :meth:`_select_weights`, with the
+        arrival-schedule fold instead of the synchronous fault fold."""
+        self._buffered_flush_quorum(round_number)
+        return self._fold_buffered_schedule(
+            round_number, None, self._base_weight_row(round_number)
+        )
+
+    def _buffered_select_indices(
+        self, round_number: int
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Gather-path ``(idx, weights, delays)`` rows under buffered
+        replay — :meth:`_base_index_rows`' ``s_pad`` padding contract,
+        arrival-schedule fold."""
+        idx, weights = self._base_index_rows(round_number)
+        self._buffered_flush_quorum(round_number)
+        weights, delays = self._fold_buffered_schedule(
+            round_number, idx, weights
+        )
+        return idx, weights, delays
+
     def _prepare_round_inputs(self, round_number: int, round_rng):
         """Device inputs for ONE round program invocation:
         ``(host_weights, weights, client_rngs, sel_idx)`` — ``sel_idx`` is
         None on the dense path.  Shared by ``run()`` and bench drivers so
-        both exercise the session's actual selection path."""
+        both exercise the session's actual selection path.  Under
+        buffered replay the staleness-delay row rides session state
+        (``_round_delays``) so every caller's dispatch surface stays
+        unchanged."""
+        if self._buffered_active:
+            if self._selection_gather:
+                host_idx, host_weights, host_delays = (
+                    self._buffered_select_indices(round_number)
+                )
+                sel_idx = put_sharded(host_idx, self._client_sharding)
+                weights = put_sharded(host_weights, self._client_sharding)
+                client_rngs = self._fold_sel_rngs(round_rng, sel_idx)
+            else:
+                sel_idx = None
+                host_weights, host_delays = self._buffered_select_weights(
+                    round_number
+                )
+                weights = put_sharded(host_weights, self._client_sharding)
+                client_rngs = self._fold_rngs(round_rng)
+            self._round_delays = put_sharded(
+                host_delays, self._client_sharding
+            )
+            return host_weights, weights, client_rngs, sel_idx
         if self._selection_gather:
             host_idx, host_weights = self._select_indices(round_number)
             sel_idx = put_sharded(host_idx, self._client_sharding)
@@ -1431,7 +2159,36 @@ class SpmdFedAvgSession(TraceCounterMixin):
         ``h`` rounds starting at ``start_round``: ``(host [h, S] weight
         matrix, device weight rows, device [h, S_pad] id rows or None)`` —
         the scanned inputs every horizon-fused session (FedAvg family AND
-        the FedOBD phase programs) feeds its round scan."""
+        the FedOBD phase programs) feeds its round scan.  Under buffered
+        replay the ``[h, S]`` staleness-delay rows ride session state
+        (``_horizon_delay_rows``) next to the weight rows."""
+        if self._buffered_active:
+            if self._selection_gather:
+                triples = [
+                    self._buffered_select_indices(r)
+                    for r in range(start_round, start_round + h)
+                ]
+                host_weights = np.stack([w for _i, w, _d in triples])
+                host_delays = np.stack([d for _i, _w, d in triples])
+                idx_rows = put_sharded(
+                    np.stack([i for i, _w, _d in triples]),
+                    self._horizon_weight_sharding,
+                )
+            else:
+                idx_rows = None
+                pairs = [
+                    self._buffered_select_weights(r)
+                    for r in range(start_round, start_round + h)
+                ]
+                host_weights = np.stack([w for w, _d in pairs])
+                host_delays = np.stack([d for _w, d in pairs])
+            self._horizon_delay_rows = put_sharded(
+                host_delays, self._horizon_weight_sharding
+            )
+            weight_rows = put_sharded(
+                host_weights, self._horizon_weight_sharding
+            )
+            return host_weights, weight_rows, idx_rows
         if self._selection_gather:
             # host-precomputed [H, s_pad] id + weight matrices — the
             # fused program gathers per scanned round
@@ -1514,6 +2271,84 @@ class SpmdFedAvgSession(TraceCounterMixin):
         params = attach_shardings(template, self._param_shardings)
         data = abstract_tree(self._data)
         val = abstract_tree(self._val_data or {})
+
+        if self._buffered_active:
+            # buffered replay: certify the dispatched per-round buffered
+            # program — params AND the pending ring are donated carries
+            # whose pinned layouts must survive the round.  The buffered
+            # HORIZON program shares these pins (same out_shardings) and
+            # is runtime-gated by the tracedump dispatch budget in
+            # test.sh / tests, so only the per-round program registers.
+            depth = self._buffered_depth
+            pending = (
+                {
+                    k: host_abstract(
+                        np.zeros((depth, *v.shape), np.float32),
+                        self._replicated,
+                    )
+                    for k, v in template.items()
+                },
+                host_abstract(
+                    np.zeros((depth,), np.float32), self._replicated
+                ),
+            )
+
+            def buffered_args(round_number):
+                if self._selection_gather:
+                    idx, weights, delays = self._buffered_select_indices(
+                        round_number
+                    )
+                    return (
+                        params,
+                        pending,
+                        host_abstract(weights, self._client_sharding),
+                        host_abstract(delays, self._client_sharding),
+                        key_abstract(self._client_sharding, (self.s_pad,)),
+                        host_abstract(idx, self._client_sharding),
+                        data,
+                        val,
+                    )
+                weights, delays = self._buffered_select_weights(
+                    round_number
+                )
+                return (
+                    params,
+                    pending,
+                    host_abstract(weights, self._client_sharding),
+                    host_abstract(delays, self._client_sharding),
+                    key_abstract(self._client_sharding, (self.n_slots,)),
+                    data,
+                    val,
+                )
+
+            specs.append(
+                ProgramSpec(
+                    name=(
+                        "round[buffered-gather]"
+                        if self._selection_gather
+                        else "round[buffered]"
+                    ),
+                    jitted=(
+                        self._jitted_buffered_gather_fn
+                        if self._selection_gather
+                        else self._jitted_buffered_round_fn
+                    ),
+                    args=buffered_args(1),
+                    alt_args=(buffered_args(2),),
+                    donate_argnums=(0, 1),
+                    mesh=self.mesh,
+                    out_pin=(
+                        (self._param_shardings, self._replicated),
+                        None,
+                    ),
+                    carries=(
+                        (0, lambda out: out[0][0]),
+                        (1, lambda out: out[0][1]),
+                    ),
+                    mesh_context=self._round_mesh_context,
+                )
+            )
+            return specs
 
         def round_args(round_number):
             if self._selection_gather:
@@ -1637,6 +2472,10 @@ class SpmdFedAvgSession(TraceCounterMixin):
                 self._trace.event(
                     "resume", round=last + 1, source=str(resume_dir)
                 )
+                # buffered resume drains the buffer: the pending ring
+                # restarts at zeros, so pre-resume origins can never
+                # merge — floor them out of cohort accounting
+                self._buffered_origin_floor = last + 1
                 return self._place_params(params), last + 1
         init_path = config.algorithm_kwargs.get("global_model_path")
         if init_path:
@@ -1750,12 +2589,16 @@ class SpmdFedAvgSession(TraceCounterMixin):
                         np.asarray(train_metrics["rejected_updates"])
                     )
                     extra["rejected_updates"] = rejected
+                if self._buffered_active:
+                    extra.update(self._buffered_round_extras(round_number))
                 self._trace_fault_event(round_number, rejected)
                 self._record(
                     round_number, metric, global_params, save_dir, extra=extra
                 )
                 # post-guard quorum: participating counts NaN-poisoned
                 # weights too (NaN != 0), matching the in-program rule
+                # (a no-op under buffered replay — the flush-cohort
+                # pre-check in _buffered_flush_quorum is the gate there)
                 self._post_guard_quorum(
                     round_number, (host_weights != 0).sum(), rejected
                 )
@@ -1865,6 +2708,8 @@ class SpmdFedAvgSession(TraceCounterMixin):
                     }
                     if rejected_rows is not None:
                         extra["rejected_updates"] = int(rejected_rows[i])
+                    if self._buffered_active:
+                        extra.update(self._buffered_round_extras(r))
                     self._trace_fault_event(
                         r,
                         rejected_rows[i] if rejected_rows is not None else 0,
@@ -2138,6 +2983,17 @@ class SpmdSignSGDSession(TraceCounterMixin):
         self._update_guard = bool(
             self._fault_plan is not None and self._fault_plan.update_guard
         )
+        # buffered aggregation is a round-upload concept; sign-SGD
+        # exchanges gradients on every optimizer STEP — reject the knob
+        # loudly instead of silently dropping it (config honesty)
+        from ..util.buffered import BufferedSettings
+
+        if BufferedSettings.from_config(config) is not None:
+            raise ValueError(
+                "algorithm_kwargs.aggregation_mode=buffered is unsupported"
+                " here: " + str(self._class_buffered_reason())
+                + " — drop the knob for this session"
+            )
         # per-round weight rows are needed whenever selection OR fault
         # injection varies the cohort round to round; the historical
         # static-weights program (and its unmasked metric sums) is kept
@@ -2442,13 +3298,25 @@ class SpmdSignSGDSession(TraceCounterMixin):
     @classmethod
     def capability_gates(cls) -> dict[str, str | None]:
         """Sign-SGD supports all three fused-round knobs (the guard is
-        the per-step vote-hygiene flavor) — see
-        :meth:`SpmdFedAvgSession.capability_gates`."""
+        the per-step vote-hygiene flavor) but not buffered aggregation —
+        see :meth:`SpmdFedAvgSession.capability_gates`."""
         return {
             "round_horizon": None,
             "selection_gather": None,
             "update_guard": None,
+            "aggregation_mode": cls._class_buffered_reason(),
         }
+
+    @classmethod
+    def _class_buffered_reason(cls) -> str | None:
+        """Sign-SGD's exchange is per optimizer STEP (a psum inside the
+        scanned step body) — there is no round-level upload for a buffer
+        flush to hold back."""
+        return (
+            "buffered aggregation (aggregation_mode: buffered) applies to"
+            " round-level uploads; sign_SGD exchanges sign votes on every"
+            " optimizer step and has no round upload to buffer"
+        )
 
     def shardcheck_shardings(self):
         """See :meth:`SpmdFedAvgSession.shardcheck_shardings`."""

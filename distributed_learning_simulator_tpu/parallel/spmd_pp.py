@@ -126,29 +126,23 @@ class SpmdPipelineSession(SingleDeviceEvalMixin, SpmdFedAvgSession):
             return P("pp")
         return P()
 
-    @classmethod
-    def _class_update_guard_reason(cls) -> str | None:
-        # inside the session shard_map the trunk params are per-STAGE
-        # local slices: a client's delta norm/finiteness check would be
-        # stage-local and could disagree across devices (divergent
-        # effective weights -> divergent aggregates).  The ep/sp layouts
-        # see full deltas (GSPMD global ops / replicated params) and
-        # support the guard; pipeline keeps the loud rejection until the
-        # guard grows a cross-stage reduction.  Class-level so the conf
-        # validator (tools/shardcheck) reports the same reason at lint
-        # time that ``__init__`` raises at round 1.
-        return (
-            "the pipeline session's trunk params are per-stage local"
-            " slices inside shard_map — the per-client delta hygiene"
-            " check cannot be evaluated consistently across stages"
-        )
-
     def _build_round_fn(self):
         engine = self._pp_engine
         epochs = self.config.epoch
         mesh = self.mesh
         _, metrics_shape = whole_mesh_session_shapes(self)
         param_specs = self._param_specs
+        # update-guard support (the last cell of the guard matrix): inside
+        # this shard_map the trunk params are per-STAGE local slices, so
+        # the per-client hygiene check guards each stage's OWN slice and
+        # all-reduces the verdict along ``pp`` (psum of slice non-finite
+        # counts + slice norm contributions; replicated leaves counted
+        # once) — every stage derives the identical effective weight, the
+        # consistency the old carve-out lacked (guard_client_update's
+        # cross-stage flavor).
+        guard_sharded = {
+            k: spec != P() for k, spec in param_specs.items()
+        }
 
         def round_program(global_params, weights, rngs, data, val):
             def shard_body(global_params, data, val, weights, rngs):
@@ -158,6 +152,10 @@ class SpmdPipelineSession(SingleDeviceEvalMixin, SpmdFedAvgSession):
                 return scan_weighted_clients(
                     engine, epochs, global_params, data, weights, rngs,
                     metrics_shape, val_data=val if val else None,
+                    guard_active=self._update_guard,
+                    max_update_norm=self._max_update_norm,
+                    guard_sharded=guard_sharded,
+                    guard_reduce_axis="pp",
                 )
 
             return shard_map_compat(

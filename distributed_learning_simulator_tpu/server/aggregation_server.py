@@ -14,7 +14,12 @@ from typing import Any
 import numpy as np
 
 from ..algorithm.aggregation_algorithm import AggregationAlgorithm
-from ..message import Message, ParameterMessage, ParameterMessageBase
+from ..message import (
+    DeltaParameterMessage,
+    Message,
+    ParameterMessage,
+    ParameterMessageBase,
+)
 from ..ops.pytree import Params
 from ..util.model_cache import ModelCache
 from ..utils.logging import get_logger
@@ -22,6 +27,12 @@ from .server import Server
 
 
 class AggregationServer(Server):
+    #: whether this server class can run ``aggregation_mode: buffered``
+    #: (staleness-weighted buffer flushes) — subclasses that own their own
+    #: round/phase progression (FedOBD's driver, Shapley's sampling,
+    #: graph servers) opt out and the knob is rejected loudly
+    _buffered_capable = True
+
     def __init__(self, algorithm: AggregationAlgorithm, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self._model_cache = ModelCache()
@@ -75,6 +86,76 @@ class AggregationServer(Server):
             # same eager default, not the recorder's 256-record buffer).
             self._trace.flush_every = 1
         self._upload_window_start: float | None = None
+        # ---- buffered-asynchronous aggregation (util/buffered.py) ----
+        # ``aggregation_mode: buffered`` removes the round barrier on THIS
+        # executor for real: the event loop (greedy sweep, server.py)
+        # consumes uploads as they arrive, holds each one keyed by its
+        # (worker, origin round), and aggregates a flush as soon as its
+        # scheduled cohort is in — a straggler's upload lands in a LATER
+        # flush with the staleness discount instead of stalling everyone.
+        # Flush membership follows the seeded arrival schedule (not
+        # wall-clock races), so runs are deterministic and the SPMD
+        # executor can replay the identical schedule bit-for-bit.
+        from ..util.buffered import BufferedSettings
+
+        self._buffered = BufferedSettings.from_config(self.config)
+        self._buffered_round_stats: dict | None = None
+        self._flush_window_start: float | None = None
+        if self._buffered is not None:
+            from ..util.buffered import threaded_buffered_reason
+
+            reason = None
+            if not self._buffered_capable:
+                reason = (
+                    f"{type(self).__name__} owns its own round/phase"
+                    " progression"
+                )
+            else:
+                reason = threaded_buffered_reason(
+                    self.config.distributed_algorithm
+                )
+            if reason is not None:
+                raise ValueError(
+                    "algorithm_kwargs.aggregation_mode=buffered is"
+                    f" unsupported here: {reason} — drop the knob for this"
+                    " server"
+                )
+            from ..util.buffered import (
+                compute_arrival_schedule,
+                threaded_uploaders,
+            )
+
+            self._bsched = compute_arrival_schedule(
+                self._buffered,
+                self._fault_plan,
+                self.worker_number,
+                self.config.round,
+                threaded_uploaders(self.config),
+            )
+            self._greedy_sweep = True
+            #: (worker, origin) -> (normalized ParameterMessage, its
+            #: origin base) — uploads held until their landing flush
+            self._held: dict[tuple[int, int], tuple] = {}
+            #: items whose upload will never arrive (injected dropout
+            #: Nones, demoted/dead workers, unselected-round acks)
+            self._cancelled: set[tuple[int, int]] = set()
+            #: per-worker next collection round (every message — upload
+            #: or None — advances it; endpoint queues are FIFO).  A
+            #: resume rebases it (_try_resume): workers jump straight to
+            #: the resumed round, so their first upload's origin is the
+            #: resume round, not 1.
+            self._origin_counter = {
+                w: 1 for w in range(self.worker_number)
+            }
+            #: origins below this are pre-resume: their scheduled flush
+            #: items can never arrive and are treated as cancelled
+            #: ("resume drains the buffer" — docs/migrating.md)
+            self._buffered_origin_floor = 1
+            #: round -> host copy of that flush's global params: the
+            #: restore base for stale deltas (a round-o upload diffs
+            #: against v_{o-1}, NOT the newest global).  Trimmed to the
+            #: schedule's staleness window.
+            self._param_history: dict[int, Params] = {}
 
     @property
     def early_stop(self) -> bool:
@@ -119,6 +200,16 @@ class AggregationServer(Server):
             self.__max_acc = restored_max
         self._round_number = last_round + 1
         self._last_saved_key = last_round  # kill deferral: already durable
+        if self._buffered is not None:
+            # buffered resume drains the buffer: workers restart at the
+            # resumed round (their init broadcast carries it), so origin
+            # counters rebase there and every pre-resume scheduled item
+            # is cancelled — a flush must never wait on an upload from
+            # before the kill (it can never arrive)
+            self._origin_counter = {
+                w: self._round_number for w in range(self.worker_number)
+            }
+            self._buffered_origin_floor = self._round_number
         get_logger().info("resumed from %s at round %d", resume_dir, self._round_number)
         return resumed_params
 
@@ -146,6 +237,9 @@ class AggregationServer(Server):
         self._trace.close()
 
     def _process_worker_data(self, worker_id: int, data: Message | None) -> None:
+        if self._buffered is not None:
+            self._process_buffered(worker_id, data)
+            return
         assert 0 <= worker_id < self.worker_number
         # telemetry.profile_rounds on this executor is server-observed:
         # the window opens at the first upload the server sees for its
@@ -182,10 +276,193 @@ class AggregationServer(Server):
             self._send_result(result)
             self._worker_flag.clear()
 
+    # ------------------------------------------ buffered flush machinery
+    def _process_buffered(self, worker_id: int, data: Message | None) -> None:
+        """Buffered-mode message intake: every message (upload or None)
+        advances the worker's origin counter; real uploads are normalized
+        against their ORIGIN's base immediately and held until their
+        scheduled landing flush; every flush whose cohort is complete
+        fires at once (several can cascade after a demotion)."""
+        assert 0 <= worker_id < self.worker_number
+        self._trace.maybe_profile_start(self._round_number)
+        origin = self._origin_counter[worker_id]
+        self._origin_counter[worker_id] = origin + 1
+        landing = self._bsched.landing.get((worker_id, origin))
+        if self._trace.enabled:
+            self._trace.event(
+                "upload",
+                worker=worker_id,
+                round=origin,
+                dropped=data is None,
+                landing=landing,
+            )
+        if data is None or not isinstance(data, ParameterMessageBase):
+            # unselected-round ack, injected dropout, or a demoted
+            # worker's synthesized None: the item (if any was scheduled)
+            # is cancelled — its flush stops waiting for it
+            self._cancelled.add((worker_id, origin))
+            if data is None:
+                self.algorithm.skipped_workers.add(worker_id)
+        elif landing is None:
+            get_logger().debug(
+                "buffered: worker %s round %s upload lands past the run"
+                " end — dropped",
+                worker_id,
+                origin,
+            )
+        else:
+            base = self._param_history.get(origin - 1)
+            message: Message = data
+            match message:
+                case DeltaParameterMessage():
+                    assert base is not None, (
+                        "buffered: stale delta restore needs the origin"
+                        f" base v_{origin - 1} (history window too small?)"
+                    )
+                    message = message.restore(base)
+                case ParameterMessage():
+                    if base is not None:
+                        message.complete(base)
+            self._held[(worker_id, origin)] = (message, base)
+            if self._flush_window_start is None:
+                self._flush_window_start = _time.monotonic()
+        while not self._stopped() and self._buffered_flush_ready():
+            self._buffered_flush()
+
+    def _buffered_flush_ready(self) -> bool:
+        """Whether the CURRENT round's flush can fire: every item the
+        arrival schedule lands here has either arrived or been cancelled.
+        Messages the cohort does not contain (stragglers' in-flight
+        uploads, trailing Nones) never block — that is the whole point."""
+        flush_round = self._round_number
+        if flush_round > self.config.round:
+            return False
+        for item in self._bsched.live_cohort(
+            flush_round, self._buffered_origin_floor
+        ):
+            key = (item.worker, item.origin)
+            if key not in self._held and key not in self._cancelled:
+                return False
+        return True
+
+    def _buffered_flush(self) -> None:
+        """Aggregate one buffer flush: the scheduled cohort's held
+        uploads, each guarded against its ORIGIN base, merged with
+        ``dataset_size × 1/(1+staleness)^alpha`` weights (normalized over
+        the survivors).  An empty flush keeps the old global — a
+        well-defined no-op round, not a degenerate aggregate."""
+        from ..algorithm.aggregation_algorithm import (
+            check_finite,
+            update_passes_guard,
+        )
+        from ..ops import pytree
+
+        flush_round = self._round_number
+        cohort = self._bsched.live_cohort(
+            flush_round, self._buffered_origin_floor
+        )
+        algo = self.algorithm
+        uploads: list[ParameterMessage] = []
+        weights: list[float] = []
+        stale_updates = 0
+        for item in cohort:
+            key = (item.worker, item.origin)
+            if key in self._cancelled:
+                algo.skipped_workers.add(item.worker)
+                continue
+            message, base = self._held.pop(key)
+            if not update_passes_guard(
+                self._fault_plan, item.worker, message.parameter, base
+            ):
+                algo.rejected_workers.add(item.worker)
+                algo.skipped_workers.add(item.worker)
+                continue
+            if item.staleness:
+                stale_updates += 1
+                if self._trace.enabled:
+                    self._trace.event(
+                        "staleness",
+                        round=flush_round,
+                        worker=item.worker,
+                        origin=item.origin,
+                        staleness=item.staleness,
+                        discount=round(item.discount, 6),
+                    )
+            uploads.append(message)
+            weights.append(float(message.dataset_size) * item.discount)
+        # buffered quorum: EXPLICIT min_client_quorum only — an empty
+        # flush keeps the old params (see the SPMD twin's rationale)
+        if self._min_quorum and len(uploads) < self._min_quorum:
+            from ..util.faults import QuorumLostError
+
+            message_text = (
+                f"flush {flush_round}: {len(uploads)} surviving buffered"
+                f" arrivals below min_client_quorum={self._min_quorum}"
+                f" (cohort {len(cohort)}, rejected"
+                f" {sorted(algo.rejected_workers)}) — aborting loudly"
+            )
+            get_logger().error(message_text)
+            raise QuorumLostError(message_text)
+        if uploads:
+            total = sum(weights)
+            layout = pytree.ParamVecLayout.of(uploads[0].parameter)
+            parameter = pytree.flat_weighted_avg_params(
+                [u.parameter for u in uploads],
+                [w / total for w in weights],
+                layout,
+            )
+            check_finite(parameter)
+            end_training = any(u.end_training for u in uploads)
+        else:
+            get_logger().info(
+                "buffered: flush %s has no landed uploads — keeping the"
+                " previous global params",
+                flush_round,
+            )
+            parameter = dict(self._model_cache.parameter_dict)
+            end_training = False
+        if self._trace.enabled and self._flush_window_start is not None:
+            # the buffered twin of the synchronous round_barrier span:
+            # first buffered arrival → flush
+            self._trace.span_record(
+                "buffer_flush",
+                _time.monotonic() - self._flush_window_start,
+                round=flush_round,
+                cohort=len(cohort),
+                stale_updates=stale_updates,
+                buffer_depth=self._bsched.buffer_depth_after(
+                    flush_round, self._buffered_origin_floor
+                ),
+            )
+            self._flush_window_start = None
+        self._buffered_round_stats = {
+            "flush_cohort": len(cohort),
+            "stale_updates": stale_updates,
+            "buffer_depth": self._bsched.buffer_depth_after(
+                flush_round, self._buffered_origin_floor
+            ),
+        }
+        self._send_result(
+            ParameterMessage(parameter=parameter, end_training=end_training)
+        )
+
     def pending_workers(self) -> set[int]:
         """Workers the current round is still waiting on — the stall
         watchdog demotes these to permanent dropouts instead of aborting
-        the task when ``fault_tolerance.client_faults_nonfatal`` is set."""
+        the task when ``fault_tolerance.client_faults_nonfatal`` is set.
+        Buffered mode waits only on the next flush's missing cohort
+        items, never on stragglers scheduled for later flushes."""
+        if self._buffered is not None:
+            if self._round_number > self.config.round:
+                return set()
+            return {
+                item.worker
+                for item in self._bsched.live_cohort(
+                    self._round_number, self._buffered_origin_floor
+                )
+                if (item.worker, item.origin) not in self._held
+                and (item.worker, item.origin) not in self._cancelled
+            }
         return set(range(self.worker_number)) - set(self._worker_flag)
 
     def _quorum_floor(self) -> int:
@@ -252,6 +529,25 @@ class AggregationServer(Server):
             self.config.save_dir, "aggregated_model", f"round_{recorded_key}.npz"
         )
         self._model_cache.cache_parameter_dict(result.parameter, model_path)
+        if self._buffered is not None:
+            # stale-delta restore bases: v_r keyed by the flush that
+            # produced it (the init broadcast keys the round BEFORE the
+            # first flush — 0 fresh, the resumed round on resume); real
+            # host copies, trimmed to the schedule's staleness window
+            key = (
+                self._round_number - 1
+                if "init" in result.other_data
+                else self._round_number
+            )
+            self._param_history[key] = {
+                k: np.array(v, copy=True)
+                for k, v in result.parameter.items()
+            }
+            window = self._bsched.max_staleness + 1
+            for stale_key in [
+                k for k in self._param_history if k < key - window
+            ]:
+                del self._param_history[stale_key]
         if self.config.checkpoint_every_round:
             # config.checkpoint_every thins the cadence (0/1 = legacy
             # every-round); the final round and an end_training aggregate
@@ -348,6 +644,10 @@ class AggregationServer(Server):
             round_stat["dropped_clients"] = len(
                 algo.skipped_workers & (dead | set(injected))
             )
+        if self._buffered is not None and self._buffered_round_stats:
+            # buffered observability: what this flush actually merged
+            # (cohort size, late arrivals, in-flight backlog)
+            round_stat.update(self._buffered_round_stats)
         self._annotate_stat(round_stat)
         key = self._get_stat_key() if stat_key is None else stat_key
         assert key not in self.__stat

@@ -7,6 +7,10 @@ from .aggregation_server import AggregationServer
 
 
 class GraphNodeServer(AggregationServer):
+    #: the embedding-passing rounds interleave non-parameter messages the
+    #: buffer-flush bookkeeping cannot hold back (aggregation_mode gate)
+    _buffered_capable = False
+
     def __init__(self, **kwargs: Any) -> None:
         kwargs.setdefault("algorithm", GraphNodeEmbeddingPassingAlgorithm())
         super().__init__(**kwargs)

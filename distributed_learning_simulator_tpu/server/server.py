@@ -22,6 +22,14 @@ from ..utils.logging import get_logger
 
 
 class Server(Executor):
+    #: buffered-aggregation event-loop mode (set by AggregationServer when
+    #: ``aggregation_mode: buffered``): drain EVERY queued message each
+    #: sweep instead of one per worker per cycle.  The one-per-cycle
+    #: cadence implements the synchronous round barrier — under buffered
+    #: flushes it would serialize consumption behind the slowest worker
+    #: and silently reinstate the barrier the mode exists to remove.
+    _greedy_sweep = False
+
     def __init__(self, task_id: int | None, endpoint, config=None, task_context=None, **kwargs: Any) -> None:
         name = "server"
         if task_id is not None:
@@ -101,14 +109,33 @@ class Server(Executor):
                 # over the survivors instead of waiting forever.  A last
                 # upload still queued from before the death is consumed
                 # first.
-                for worker_id in sorted(self._dropped_workers() & worker_set):
+                dropped = self._dropped_workers() & worker_set
+                if self._greedy_sweep:
+                    # buffered mode: only synthesize a dead worker's None
+                    # when the next flush actually waits on it — the
+                    # greedy drain consumes real messages as fast as they
+                    # arrive, so an every-sweep synthesis would run away
+                    pending_fn = getattr(self, "pending_workers", None)
+                    dropped &= (
+                        set(pending_fn()) if pending_fn is not None else set()
+                    )
+                for worker_id in sorted(dropped):
                     if self._endpoint.has_data(worker_id):
                         continue
                     self._process_worker_data(worker_id, None)
-                    worker_set.remove(worker_id)
+                    if not self._greedy_sweep:
+                        worker_set.remove(worker_id)
                     progressed = True
                 for worker_id in sorted(worker_set):
-                    if self._endpoint.has_data(worker_id):
+                    if self._greedy_sweep:
+                        while not self._stopped() and self._endpoint.has_data(
+                            worker_id
+                        ):
+                            self._process_worker_data(
+                                worker_id, self._endpoint.get(worker_id)
+                            )
+                            progressed = True
+                    elif self._endpoint.has_data(worker_id):
                         data = self._endpoint.get(worker_id)
                         self._process_worker_data(worker_id, data)
                         worker_set.remove(worker_id)

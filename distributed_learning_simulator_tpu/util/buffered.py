@@ -1,0 +1,330 @@
+"""Buffered-asynchronous aggregation: the shared deterministic core.
+
+Every executor in this repo was round-barriered: one straggling client
+stalled the whole round — exactly the failure mode production federated
+systems engineer around (SURVEY §5's Bonawitz architecture; *FedBuff*,
+Nguyen et al., is the canonical buffered design).  ``aggregation_mode:
+buffered`` removes the barrier: the server aggregates a **buffer flush**
+of the first ``buffer_size`` arrivals, applies a **staleness-weighted
+merge** (``weight ∝ 1 / (1 + staleness)^staleness_alpha``), and lets a
+straggler's update land in a *later* flush with discount instead of
+blocking.
+
+The part that makes this testable — and replayable bit-for-bit across
+executors — is that the arrival process is **scheduled, not raced**:
+which flush each ``(client, origin round)`` update lands in derives
+entirely from the seeded :class:`~.faults.FaultPlan` straggler draws
+(per-client delay magnitudes → staleness in rounds) plus the FIFO
+buffer-capacity cascade below.  The threaded executor uses the schedule
+to decide flush membership (wall-clock sleeps only shape the realism and
+the bench's measured win); the SPMD executor *replays* the identical
+schedule in-program (``parallel/spmd.py``: the per-round staleness rows
+route each trained contribution into a pending ring that merges at its
+landing flush).  Two executors, one arrival schedule, same final params.
+
+Config surface (``algorithm_kwargs``)::
+
+    aggregation_mode: buffered   # default "synchronous" — bit-exact legacy
+    buffer_size: 0               # flush capacity; 0 = unbounded (no overflow)
+    staleness_alpha: 0.5         # discount exponent (FedBuff's 1/sqrt(1+s))
+
+Queue semantics (one rule, both executors):
+
+* update ``(c, o)`` is *scheduled* to land at flush ``o + s(c, o)`` where
+  ``s`` is :meth:`FaultPlan.staleness_rounds` (0 unless straggling);
+* a flush merges at most ``buffer_size`` items — stale items first
+  (FIFO: oldest origin, then worker id), then on-time arrivals by worker
+  id; the overflow rolls to the next flush with one more round of
+  staleness (and one more notch of discount);
+* a dropped client's update never arrives and never lands anywhere; a
+  corrupt client's update lands poisoned at its scheduled flush (the
+  update guard rejects it there);
+* items whose landing falls past the run's last round are **dropped** —
+  a resumed or finished run never merges updates from a dead world (this
+  is also why resume restarts with an empty buffer).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from .faults import FaultPlan
+
+_MODES = ("synchronous", "buffered")
+
+
+@dataclasses.dataclass(frozen=True)
+class BufferedSettings:
+    """Parsed ``aggregation_mode`` knobs (None = synchronous legacy)."""
+
+    buffer_size: int = 0  # 0 = unbounded
+    staleness_alpha: float = 0.5
+
+    @classmethod
+    def from_config(cls, config) -> "BufferedSettings | None":
+        """Build from ``config.algorithm_kwargs`` — ``None`` when the mode
+        is absent or ``synchronous`` (the bit-exact default).  Invalid
+        values raise: an accepted-but-unread knob is a silent config drop
+        (the repo's config-honesty rule)."""
+        kwargs = dict(getattr(config, "algorithm_kwargs", None) or {})
+        mode = str(kwargs.get("aggregation_mode") or "synchronous").lower()
+        if mode not in _MODES:
+            raise ValueError(
+                f"algorithm_kwargs.aggregation_mode must be one of {_MODES},"
+                f" got {kwargs.get('aggregation_mode')!r}"
+            )
+        if mode != "buffered":
+            for knob in ("buffer_size", "staleness_alpha"):
+                if knob in kwargs:
+                    raise ValueError(
+                        f"algorithm_kwargs.{knob} is set but"
+                        " aggregation_mode is not 'buffered' — the knob"
+                        " would be silently ignored; drop it or enable"
+                        " buffered aggregation"
+                    )
+            return None
+        buffer_size = int(kwargs.get("buffer_size", 0) or 0)
+        if buffer_size < 0:
+            raise ValueError(
+                f"algorithm_kwargs.buffer_size must be >= 0 (0 ="
+                f" unbounded), got {buffer_size}"
+            )
+        alpha = float(kwargs.get("staleness_alpha", 0.5))
+        if alpha < 0:
+            raise ValueError(
+                "algorithm_kwargs.staleness_alpha must be >= 0, got"
+                f" {alpha}"
+            )
+        return cls(buffer_size=buffer_size, staleness_alpha=alpha)
+
+
+#: the threaded-server algorithms whose aggregation IS a staleness-
+#: weightable FedAvg merge — the single source behind the runtime gate
+#: (AggregationServer.__init__) AND tools/shardcheck's conf validator
+BUFFERED_THREADED_ALGORITHMS = ("fed_avg", "fed_paq")
+
+
+def threaded_buffered_reason(algorithm: str) -> str | None:
+    """Why the threaded executor cannot run ``aggregation_mode:
+    buffered`` for this algorithm (None = supported) — one definition so
+    the lint-time and runtime rejections can never drift."""
+    if algorithm not in BUFFERED_THREADED_ALGORITHMS:
+        return (
+            f"the {algorithm!r} aggregation semantics are not a"
+            " staleness-weightable FedAvg merge"
+        )
+    return None
+
+
+def staleness_discount(staleness: int, alpha: float) -> float:
+    """The FedBuff-style staleness discount ``1 / (1 + s)^alpha``,
+    computed in host float64 — THE reference the f32 device rows are
+    pinned against (``tests/test_async_aggregation.py``)."""
+    return float((1.0 + float(staleness)) ** (-float(alpha)))
+
+
+@dataclasses.dataclass(frozen=True)
+class FlushItem:
+    """One update merged at a flush: ``worker``'s round-``origin`` upload,
+    ``staleness`` flushes late (0 = on time), discounted by
+    ``discount``."""
+
+    worker: int
+    origin: int
+    staleness: int
+    discount: float
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrivalSchedule:
+    """The precomputed flush membership for a whole run — the single
+    artifact both executors consume (and the tests pin)."""
+
+    flushes: dict[int, tuple[FlushItem, ...]]
+    #: (worker, origin) -> flush round it lands at (missing = never lands)
+    landing: dict[tuple[int, int], int]
+    max_staleness: int
+    staleness_alpha: float
+
+    def delay(self, worker: int, origin: int) -> int | None:
+        """Flushes the (worker, origin) update waits before merging, or
+        None when it never lands (dropped / past the run's end)."""
+        land = self.landing.get((worker, origin))
+        return None if land is None else land - origin
+
+    def cohort(self, flush_round: int) -> tuple[FlushItem, ...]:
+        return self.flushes.get(flush_round, ())
+
+    def live_cohort(
+        self, flush_round: int, origin_floor: int = 1
+    ) -> tuple[FlushItem, ...]:
+        """The cohort items that can actually arrive: a resumed run's
+        workers restart at the resume round, so items with origins below
+        the floor are phantoms — their uploads (threaded) / pending
+        contributions (SPMD) died with the killed process ("resume
+        drains the buffer")."""
+        return tuple(
+            item
+            for item in self.cohort(flush_round)
+            if item.origin >= origin_floor
+        )
+
+    def stale_count(self, flush_round: int, origin_floor: int = 1) -> int:
+        return sum(
+            1
+            for item in self.live_cohort(flush_round, origin_floor)
+            if item.staleness
+        )
+
+    def buffer_depth_after(
+        self, flush_round: int, origin_floor: int = 1
+    ) -> int:
+        """Updates still in flight after this flush: trained at or before
+        ``flush_round`` but landing later (the buffered backlog)."""
+        return sum(
+            1
+            for (_w, origin), land in self.landing.items()
+            if origin_floor <= origin <= flush_round < land
+        )
+
+    def all_staleness(self) -> list[int]:
+        """Every merged update's staleness, flush order — the bench's
+        ``staleness_p50`` source."""
+        return [
+            item.staleness
+            for r in sorted(self.flushes)
+            for item in self.flushes[r]
+        ]
+
+
+def compute_arrival_schedule(
+    settings: BufferedSettings,
+    plan: FaultPlan | None,
+    worker_number: int,
+    total_rounds: int,
+    uploaders: Callable[[int], tuple[int, ...]],
+) -> ArrivalSchedule:
+    """Run the deterministic queue process (module docstring) over the
+    whole schedule.  ``uploaders(round)`` names the workers whose round-
+    ``round`` upload actually exists — each executor passes its own
+    participation rule (selection; the threaded executor's broadcast
+    cadence), and dropped clients are excluded here so their updates
+    never enter any buffer."""
+    pending: dict[int, list[tuple[int, int]]] = {}  # landing -> [(origin, w)]
+    flushes: dict[int, tuple[FlushItem, ...]] = {}
+    landing: dict[tuple[int, int], int] = {}
+    max_staleness = 0
+    capacity = settings.buffer_size
+
+    for flush_round in range(1, total_rounds + 1):
+        dropped = (
+            plan.dropped_clients(flush_round, worker_number)
+            if plan is not None
+            else frozenset()
+        )
+        for worker in sorted(uploaders(flush_round)):
+            if worker in dropped:
+                continue  # the upload is lost, not late
+            staleness = (
+                plan.staleness_rounds(flush_round, worker, worker_number)
+                if plan is not None
+                else 0
+            )
+            pending.setdefault(flush_round + staleness, []).append(
+                (flush_round, worker)
+            )
+        # stale items are already in the buffer (FIFO by origin, worker);
+        # on-time items queue behind them in worker order — "the first K
+        # arrivals" with a deterministic tie-break
+        candidates = sorted(pending.pop(flush_round, ()))
+        if capacity and len(candidates) > capacity:
+            overflow = candidates[capacity:]
+            candidates = candidates[:capacity]
+            pending.setdefault(flush_round + 1, []).extend(overflow)
+        cohort = []
+        for origin, worker in candidates:
+            staleness = flush_round - origin
+            max_staleness = max(max_staleness, staleness)
+            landing[(worker, origin)] = flush_round
+            cohort.append(
+                FlushItem(
+                    worker=worker,
+                    origin=origin,
+                    staleness=staleness,
+                    discount=staleness_discount(
+                        staleness, settings.staleness_alpha
+                    ),
+                )
+            )
+        flushes[flush_round] = tuple(cohort)
+    # anything still pending lands past the run's end and is dropped —
+    # but a leftover's WAIT still stretches the ring depth the SPMD
+    # replay must carry, so account it in max_staleness via the items
+    # that DID land (leftovers never merge, so they need no ring slot)
+    return ArrivalSchedule(
+        flushes=flushes,
+        landing=landing,
+        max_staleness=max_staleness,
+        staleness_alpha=settings.staleness_alpha,
+    )
+
+
+def selection_uploaders(config) -> Callable[[int], tuple[int, ...]]:
+    """The SPMD executor's participation rule: the round's selected
+    workers (``utils/selection.py``) — the same rule its weight rows are
+    built from."""
+    from ..utils.selection import select_workers
+
+    def uploaders(round_number: int) -> tuple[int, ...]:
+        return tuple(
+            sorted(
+                select_workers(
+                    config.seed,
+                    round_number,
+                    config.worker_number,
+                    config.algorithm_kwargs.get("random_client_number"),
+                )
+            )
+        )
+
+    return uploaders
+
+
+def threaded_uploaders(config) -> Callable[[int], tuple[int, ...]]:
+    """The threaded executor's participation rule.  Its broadcast cadence
+    selects workers at send time with the server's CURRENT round counter
+    (``server/server.py::_select_workers``): the init broadcast and the
+    round-1 result both select with round 1, so collection round ``o``'s
+    uploaders are ``select_workers(seed, max(1, o - 1))`` — one round
+    behind the SPMD rule under partial participation (PARITY.md; under
+    full participation, the cross-executor-pinned case, the rules
+    coincide)."""
+    from ..utils.selection import select_workers
+
+    def uploaders(round_number: int) -> tuple[int, ...]:
+        return tuple(
+            sorted(
+                select_workers(
+                    config.seed,
+                    max(1, round_number - 1),
+                    config.worker_number,
+                    config.algorithm_kwargs.get("random_client_number"),
+                )
+            )
+        )
+
+    return uploaders
+
+
+__all__ = [
+    "ArrivalSchedule",
+    "BUFFERED_THREADED_ALGORITHMS",
+    "BufferedSettings",
+    "FlushItem",
+    "compute_arrival_schedule",
+    "selection_uploaders",
+    "staleness_discount",
+    "threaded_buffered_reason",
+    "threaded_uploaders",
+]

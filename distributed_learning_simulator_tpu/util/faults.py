@@ -18,6 +18,9 @@ driven entirely from ``config.fault_tolerance``:
       dropout_schedule: {2: [0, 3]}  # explicit per-round dropped worker ids
       straggler_rate: 0.0          # per-(round, client) straggle draw ...
       straggler_delay_seconds: 0.0 # ... each sleeping this long (host-side)
+      straggler_delay_spread: 0.0  # seeded per-client delay multiplier in
+                                   # [1, 1+spread) — tunable arrival skew;
+                                   # buffered staleness = ceil(delay/base)
       straggler_schedule: {}
       corrupt_rate: 0.0            # per-(round, client) poisoned upload
       corrupt_schedule: {}
@@ -86,6 +89,7 @@ _KNOWN_KEYS = frozenset(
         "dropout_schedule",
         "straggler_rate",
         "straggler_delay_seconds",
+        "straggler_delay_spread",
         "straggler_schedule",
         "corrupt_rate",
         "corrupt_schedule",
@@ -103,6 +107,7 @@ _KNOWN_KEYS = frozenset(
 _DROPOUT_STREAM = 1
 _STRAGGLER_STREAM = 2
 _CORRUPT_STREAM = 3
+_DELAY_STREAM = 4
 
 
 def _normalize_schedule(raw: Any) -> dict[int, frozenset[int]]:
@@ -127,6 +132,13 @@ class FaultPlan:
     )
     straggler_rate: float = 0.0
     straggler_delay_seconds: float = 0.0
+    #: per-client delay skew: each straggling (round, client) draws a
+    #: seeded multiplier in [1, 1 + spread) on ``straggler_delay_seconds``,
+    #: so arrival order inside a round is a controlled, tunable workload
+    #: (0 = the legacy constant delay for every straggler).  Buffered
+    #: aggregation derives each straggler's *staleness in rounds* from the
+    #: same draw: ``ceil(delay / straggler_delay_seconds)`` flushes missed.
+    straggler_delay_spread: float = 0.0
     straggler_schedule: Mapping[int, frozenset[int]] = dataclasses.field(
         default_factory=dict
     )
@@ -170,6 +182,9 @@ class FaultPlan:
             straggler_rate=float(raw.get("straggler_rate", 0.0) or 0.0),
             straggler_delay_seconds=float(
                 raw.get("straggler_delay_seconds", 0.0) or 0.0
+            ),
+            straggler_delay_spread=float(
+                raw.get("straggler_delay_spread", 0.0) or 0.0
             ),
             straggler_schedule=_normalize_schedule(
                 raw.get("straggler_schedule")
@@ -258,21 +273,81 @@ class FaultPlan:
         )
 
     # ------------------------------------------------------------------
+    def _delay_multiplier(self, round_number: int, worker_id: int) -> float:
+        """Seeded per-(round, client) delay multiplier in
+        ``[1, 1 + straggler_delay_spread)`` — deterministic like every
+        other draw, so the arrival schedule is replayable."""
+        if self.straggler_delay_spread <= 0:
+            return 1.0
+        rng = random.Random(
+            ((self.seed * 1_000_003 + round_number) * 31 + _DELAY_STREAM)
+            * 1_000_003
+            + worker_id
+        )
+        return 1.0 + self.straggler_delay_spread * rng.random()
+
+    def straggler_delay(
+        self, round_number: int, worker_id: int, worker_number: int
+    ) -> float:
+        """This client's upload delay (seconds) for the round: 0 for a
+        non-straggler, else ``straggler_delay_seconds`` times its seeded
+        per-client multiplier (``straggler_delay_spread``)."""
+        if worker_id not in self.straggling_clients(
+            round_number, worker_number
+        ):
+            return 0.0
+        return self.straggler_delay_seconds * self._delay_multiplier(
+            round_number, worker_id
+        )
+
+    def staleness_rounds(
+        self, round_number: int, worker_id: int, worker_number: int
+    ) -> int:
+        """How many buffer flushes this client's round upload misses under
+        buffered aggregation (0 = on time).  The staleness model treats
+        ``straggler_delay_seconds`` as one round's wall-clock: a straggler
+        misses ``ceil(delay / straggler_delay_seconds)`` flush boundaries,
+        so the legacy constant delay is exactly one round late and the
+        ``straggler_delay_spread`` multiplier stretches deeper staleness
+        (a flag-only plan with no delay configured still misses one flush
+        — a straggler is by definition not on time)."""
+        if worker_id not in self.straggling_clients(
+            round_number, worker_number
+        ):
+            return 0
+        if self.straggler_delay_seconds <= 0:
+            return 1
+        import math
+
+        multiplier = self._delay_multiplier(round_number, worker_id)
+        return max(1, math.ceil(multiplier - 1e-9))
+
     def straggler_sleep(
         self, round_number: int, worker_number: int, worker_id: int | None = None
     ) -> None:
         """Host-side straggler delay.  With ``worker_id`` (threaded path):
-        sleep iff that worker straggles this round.  Without (SPMD path):
-        sleep once iff ANY client straggles — the lock-step round completes
-        when the slowest upload arrives, so one max-delay models it."""
+        sleep that worker's own seeded delay iff it straggles this round.
+        Without (SPMD barriered path): sleep once for the SLOWEST
+        straggler — the lock-step round completes when the slowest upload
+        arrives, so one max-delay models it."""
         if self.straggler_delay_seconds <= 0:
             return
         straggling = self.straggling_clients(round_number, worker_number)
         if not straggling:
             return
-        if worker_id is not None and worker_id not in straggling:
+        if worker_id is not None:
+            if worker_id not in straggling:
+                return
+            time.sleep(
+                self.straggler_delay(round_number, worker_id, worker_number)
+            )
             return
-        time.sleep(self.straggler_delay_seconds)
+        time.sleep(
+            max(
+                self.straggler_delay(round_number, w, worker_number)
+                for w in straggling
+            )
+        )
 
     def should_kill_after(self, round_number: int) -> bool:
         return round_number in self.kill_after_rounds

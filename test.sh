@@ -84,10 +84,40 @@ run --config-name large_scale/fed_obd/moe_imdb_ep.yaml \
   ++fed_obd.fault_tolerance.corrupt_schedule.2='[0]' \
   ++fed_obd.fault_tolerance.update_guard=True
 
+# buffered-aggregation smoke (util/buffered.py): FedBuff-style rounds
+# under a seeded straggler plan with per-client delay magnitudes, on
+# BOTH executors — the threaded server's buffer flushes (no round
+# barrier: the event loop must finish without waiting out the sleeps)
+# and the fused SPMD pending-ring replay of the SAME arrival schedule.
+# The buffered SPMD trace must hold the fused dispatch budget with zero
+# retraces, asserted through the tracedump gate below.
+for exec_mode in sequential spmd; do
+  extra=""
+  if [ "$exec_mode" = spmd ]; then
+    extra="++fed_avg.algorithm_kwargs.round_horizon=2"
+  fi
+  run --config-name fed_avg/mnist_buffered.yaml \
+    ++fed_avg.round=4 ++fed_avg.epoch=1 ++fed_avg.worker_number=4 \
+    ++fed_avg.executor=$exec_mode \
+    ++fed_avg.algorithm_kwargs.random_client_number=4 \
+    ++fed_avg.fault_tolerance.straggler_schedule.1='[0]' \
+    ++fed_avg.fault_tolerance.straggler_delay_seconds=0.2 \
+    ++fed_avg.dataset_kwargs.train_size=128 ++fed_avg.dataset_kwargs.test_size=64 \
+    ++fed_avg.telemetry.enabled=True \
+    ++fed_avg.save_dir=$TRACE_SMOKE/buffered_$exec_mode $extra
+done
+
 # roundtrace gates (tools/tracedump): the fused SPMD smoke trace must
 # hold the dispatch budget at runtime (the same invariant shardcheck
 # certified statically above) and observe zero retraces; every
-# telemetry-on trace must round-trip through the JSON summarizer
+# telemetry-on trace must round-trip through the JSON summarizer.  The
+# buffered SPMD replay holds the SAME budget — buffered semantics fuse.
+python3 -m tools.tracedump "$TRACE_SMOKE/buffered_spmd/server/trace.jsonl" \
+  --assert-budget "dispatches_per_round<=1" \
+  --assert-budget "retrace_events==0" \
+  --assert-budget "stale_updates_total>=1"
+python3 -m tools.tracedump "$TRACE_SMOKE/buffered_sequential/server/trace.jsonl" \
+  --format json > /dev/null
 python3 -m tools.tracedump "$TRACE_SMOKE/spmd/server/trace.jsonl" \
   --assert-budget "dispatches_per_round<=1" \
   --assert-budget "retrace_events==0"

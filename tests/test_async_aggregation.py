@@ -1,0 +1,867 @@
+"""Buffered-asynchronous aggregation (ISSUE 11 / ROADMAP "Next
+directions" 3): FedBuff-style rounds with a staleness-weighted merge and
+a deterministic cross-executor replay.
+
+What these tests pin:
+
+* ``aggregation_mode`` absent / ``synchronous`` is a bit-exact no-op, and
+  a buffered run whose arrival schedule has NO late arrivals (depth 0)
+  traces the UNCHANGED synchronous programs — also bit-exact;
+* the deterministic arrival schedule (``util/buffered.py``): staleness
+  from the seeded per-client delay magnitudes, FIFO buffer-capacity
+  overflow cascades, never-landing drops, and the f64 discount rule the
+  f32 device rows are cast from;
+* the threaded executor's buffer flushes and the SPMD executor's
+  pending-ring replay of the SAME schedule agree on final params;
+* the SPMD replay fuses: buffered H=1 vs fused H=4 bit-exact at
+  ≤ 1 dispatch/round with zero retraces (tracedump-asserted);
+* the buffered × dropout × quorum × guard chaos axis composes on both
+  executors (slow-marked whole-run cases);
+* the pipeline ``update_guard`` carve-out is CLOSED: the cross-stage
+  guard reduction produces stage-consistent verdicts equal to the
+  unsharded guard's.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import fed_avg_config
+from distributed_learning_simulator_tpu.parallel.spmd import (
+    SpmdFedAvgSession,
+    guard_client_update,
+    scan_local_epochs,
+    shard_map_compat,
+)
+from distributed_learning_simulator_tpu.training import _build_task, train
+from distributed_learning_simulator_tpu.util.buffered import (
+    BufferedSettings,
+    compute_arrival_schedule,
+    selection_uploaders,
+    staleness_discount,
+)
+from distributed_learning_simulator_tpu.util.faults import FaultPlan
+
+
+def make_config(save_dir: str, **overrides):
+    base = dict(
+        executor="spmd",
+        worker_number=4,
+        batch_size=16,
+        round=3,
+        epoch=1,
+        dataset_kwargs={"train_size": 64, "val_size": 16, "test_size": 32},
+        save_dir=str(save_dir),
+        log_file="",
+    )
+    base.update(overrides)
+    return fed_avg_config(**base)
+
+
+BUFFERED = {"aggregation_mode": "buffered", "staleness_alpha": 0.5}
+#: a fixed arrival schedule: worker 0 late in round 1, worker 2 in round 2
+STRAGGLERS = {"seed": 1, "straggler_schedule": {1: [0], 2: [2]}}
+
+
+def _run_spmd(config):
+    ctx = _build_task(config)
+    session = SpmdFedAvgSession(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+    return session, session.run()
+
+
+def _final_params(save_dir, round_number):
+    path = os.path.join(
+        str(save_dir), "aggregated_model", f"round_{round_number}.npz"
+    )
+    with np.load(path) as blob:
+        return {k: blob[k] for k in blob.files}
+
+
+def _assert_bit_exact(a: dict, b: dict) -> None:
+    assert set(a) == set(b)
+    for key in a:
+        assert np.array_equal(a[key], b[key]), key
+
+
+# ---------------------------------------------------------------- no-op
+def test_synchronous_mode_is_bit_exact_noop(tmp_path):
+    """Explicit ``aggregation_mode: synchronous`` == the knob absent,
+    param-for-param bit-exact (and no buffered machinery builds)."""
+    _, _ = _run_spmd(make_config(tmp_path / "absent"))
+    session, _ = _run_spmd(
+        make_config(
+            tmp_path / "explicit",
+            algorithm_kwargs={"aggregation_mode": "synchronous"},
+        )
+    )
+    assert session._buffered is None
+    assert session._pending is None
+    _assert_bit_exact(
+        _final_params(tmp_path / "absent", 3),
+        _final_params(tmp_path / "explicit", 3),
+    )
+
+
+def test_buffered_depth_zero_degenerates_to_synchronous(tmp_path):
+    """A buffered run with no stragglers and no overflow has a depth-0
+    schedule and traces the UNCHANGED synchronous programs — bit-exact,
+    the structural half of the no-op pin."""
+    _, _ = _run_spmd(make_config(tmp_path / "sync"))
+    session, _ = _run_spmd(
+        make_config(
+            tmp_path / "buffered", algorithm_kwargs=dict(BUFFERED)
+        )
+    )
+    assert session._buffered is not None
+    assert session._buffered_depth == 0
+    assert not session._buffered_active
+    _assert_bit_exact(
+        _final_params(tmp_path / "sync", 3),
+        _final_params(tmp_path / "buffered", 3),
+    )
+
+
+# ------------------------------------------------------------- schedule
+def test_arrival_schedule_staleness_and_landing(tmp_path):
+    config = make_config(
+        tmp_path, round=4, fault_tolerance=dict(STRAGGLERS)
+    )
+    schedule = compute_arrival_schedule(
+        BufferedSettings(staleness_alpha=0.5),
+        FaultPlan.from_config(config),
+        config.worker_number,
+        config.round,
+        selection_uploaders(config),
+    )
+    assert schedule.max_staleness == 1
+    # worker 0's round-1 update lands at flush 2; round-1's flush holds
+    # the on-time three
+    assert schedule.delay(0, 1) == 1
+    assert [i.worker for i in schedule.cohort(1)] == [1, 2, 3]
+    cohort2 = [(i.worker, i.origin, i.staleness) for i in schedule.cohort(2)]
+    # stale items merge FIRST (FIFO by origin), then the on-time arrivals
+    assert cohort2[0] == (0, 1, 1)
+    assert (2, 2, 0) not in cohort2  # worker 2 straggles round 2
+    assert schedule.delay(2, 2) == 1
+    # discounts follow the f64 rule
+    for item in schedule.cohort(2):
+        assert item.discount == staleness_discount(item.staleness, 0.5)
+    assert schedule.stale_count(2) == 1
+    assert schedule.buffer_depth_after(2) == 1  # worker 2's is in flight
+
+
+def test_arrival_schedule_capacity_overflow_cascades(tmp_path):
+    """``buffer_size`` K: a flush merges at most K items; the overflow
+    rolls forward with one more round of staleness (and a deeper
+    discount), and leftovers past the last round never land."""
+    config = make_config(tmp_path, round=2)
+    schedule = compute_arrival_schedule(
+        BufferedSettings(buffer_size=3, staleness_alpha=1.0),
+        None,
+        config.worker_number,
+        config.round,
+        selection_uploaders(config),
+    )
+    assert [
+        (i.worker, i.staleness) for i in schedule.cohort(1)
+    ] == [(0, 0), (1, 0), (2, 0)]
+    # worker 3's round-1 update overflowed into flush 2 with staleness 1
+    # (oldest-first), displacing one round-2 arrival into the void
+    cohort2 = [(i.worker, i.origin, i.staleness) for i in schedule.cohort(2)]
+    assert cohort2[0] == (3, 1, 1)
+    assert len(cohort2) == 3
+    assert schedule.cohort(2)[0].discount == staleness_discount(1, 1.0)
+    # the two displaced round-2 leftovers land past the run's end: dropped
+    merged = set(schedule.landing)
+    expected = {(w, r) for r in (1, 2) for w in range(4)}
+    assert expected - merged == {(2, 2), (3, 2)}
+
+
+def test_staleness_weights_match_host_f64_reference(tmp_path):
+    """The f32 weight rows the device consumes are the f64 discount rule
+    (``dataset_size × (1+s)^-alpha``) cast once — pinned leaf-for-leaf
+    against an independent float64 computation."""
+    config = make_config(
+        tmp_path,
+        round=3,
+        fault_tolerance=dict(STRAGGLERS),
+        algorithm_kwargs={**BUFFERED, "staleness_alpha": 0.7},
+    )
+    ctx = _build_task(config)
+    session = SpmdFedAvgSession(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+    schedule = session._arrival_schedule
+    for round_number in (1, 2, 3):
+        weights, delays = session._buffered_select_weights(round_number)
+        for worker in range(config.worker_number):
+            delay = schedule.delay(worker, round_number)
+            if delay is None:
+                assert weights[worker] == 0.0
+                continue
+            reference = np.float64(
+                session._dataset_sizes[worker]
+            ) * np.float64(1.0 + delay) ** np.float64(-0.7)
+            assert weights[worker] == np.float32(reference), (
+                round_number,
+                worker,
+            )
+            assert delays[worker] == delay
+
+
+def test_buffered_merge_matches_host_f64_stream(tmp_path):
+    """End-to-end staleness-weight reference: flush 2 of a buffered run
+    (three on-time round-2 updates + worker 0's stale round-1 update)
+    must equal the host float64 staleness-weighted merge of the SAME
+    per-client local-training results, to float32-summation tolerance —
+    the buffered twin of test_fedavg_parity's f64 stream pin."""
+    config = make_config(
+        tmp_path / "run",
+        round=2,
+        fault_tolerance={"seed": 1, "straggler_schedule": {1: [0]}},
+        algorithm_kwargs=dict(BUFFERED),
+    )
+    ctx = _build_task(config)
+    session = SpmdFedAvgSession(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+    engine = ctx.engine
+
+    def flat(params):
+        return np.concatenate(
+            [
+                np.asarray(leaf, np.float64).ravel()
+                for leaf in jax.tree.leaves(params)
+            ]
+        )
+
+    # host replay of run(): the fold_in rng chain and REAL param copies
+    global_params, _ = session._init_global_params()
+    host_global = {k: np.array(v, copy=True) for k, v in global_params.items()}
+    host_data = jax.tree.map(lambda x: np.asarray(x), session._data)
+    local_fn = jax.jit(
+        lambda g, d, r: scan_local_epochs(engine, config.epoch, g, d, r)[0]
+    )
+
+    def client_params(host_global, round_rng, worker):
+        client_rng = jax.random.fold_in(round_rng, worker)
+        slot_rng, _ = jax.random.split(client_rng)  # local_train splits
+        slot_data = jax.tree.map(lambda x: x[worker], host_data)
+        trained = local_fn(host_global, slot_data, slot_rng)
+        return {k: np.array(v, copy=True) for k, v in trained.items()}
+
+    rng = jax.random.PRNGKey(config.seed)
+    rng, round1_rng = jax.random.split(rng)
+    weights1, _ = session._buffered_select_weights(1)
+    weights2, _ = session._buffered_select_weights(2)
+    round1 = {
+        w: client_params(host_global, round1_rng, w)
+        for w in range(config.worker_number)
+    }
+    # flush 1 in f64: the three on-time updates (worker 0 held back)
+    acc = np.zeros_like(flat(host_global))
+    total = np.float64(0.0)
+    for w in range(1, config.worker_number):
+        acc += np.float64(weights1[w]) * flat(round1[w])
+        total += np.float64(weights1[w])
+    v1_flat = acc / total
+    # rebuild v1 as a params dict for round-2 training (cast back to f32
+    # exactly like the device does)
+    v1 = {}
+    offset = 0
+    for key in sorted(host_global):
+        size = host_global[key].size
+        v1[key] = (
+            v1_flat[offset : offset + size]
+            .reshape(host_global[key].shape)
+            .astype(np.float32)
+        )
+        offset += size
+    _, round2_rng = jax.random.split(rng)
+    # flush 2 in f64: all four round-2 updates + worker 0's STALE round-1
+    # update at its pre-discounted weight (weights1[0] already carries
+    # the 1/(1+1)^alpha discount the training-round row folded in)
+    acc = np.zeros_like(v1_flat)
+    total = np.float64(0.0)
+    for w in range(config.worker_number):
+        trained = client_params(v1, round2_rng, w)
+        acc += np.float64(weights2[w]) * flat(trained)
+        total += np.float64(weights2[w])
+    acc += np.float64(weights1[0]) * flat(round1[0])
+    total += np.float64(weights1[0])
+    reference = acc / total
+
+    session.run()
+    device = flat(_final_params(tmp_path / "run", 2))
+    scale = np.abs(reference).max()
+    assert scale > 0
+    relative = np.abs(device - reference).max() / scale
+    assert relative <= 1e-5, (
+        f"buffered flush vs host-f64 reference: rel err {relative:.3e}"
+    )
+
+
+# ----------------------------------------------- cross-executor replay
+def test_threaded_flushes_match_spmd_replay(tmp_path):
+    """THE tentpole pin: the threaded executor's buffer flushes and the
+    SPMD pending-ring replay of the SAME fixed arrival schedule agree on
+    final params (float32-summation tolerance) and on every flush's
+    cohort accounting."""
+    fault_tolerance = {
+        "seed": 1,
+        "straggler_schedule": {1: [0], 2: [2]},
+        "straggler_delay_seconds": 0.05,
+    }
+    threaded = make_config(
+        tmp_path / "threaded",
+        executor="sequential",
+        worker_number=3,
+        dataset_kwargs={"train_size": 48, "val_size": 12, "test_size": 32},
+        fault_tolerance=dict(fault_tolerance),
+        algorithm_kwargs=dict(BUFFERED),
+    )
+    result_threaded = train(threaded)
+    spmd = make_config(
+        tmp_path / "spmd",
+        worker_number=3,
+        dataset_kwargs={"train_size": 48, "val_size": 12, "test_size": 32},
+        fault_tolerance=dict(fault_tolerance),
+        algorithm_kwargs=dict(BUFFERED),
+    )
+    _, result_spmd = _run_spmd(spmd)
+    for round_number in (1, 2, 3):
+        row_t = result_threaded["performance"][round_number]
+        row_s = result_spmd["performance"][round_number]
+        for column in ("flush_cohort", "stale_updates", "buffer_depth"):
+            assert row_t[column] == row_s[column], (round_number, column)
+    params_t = _final_params(tmp_path / "threaded", 3)
+    params_s = _final_params(tmp_path / "spmd", 3)
+    scale = max(
+        float(np.abs(np.asarray(v, np.float64)).max())
+        for v in params_s.values()
+    )
+    error = max(
+        float(
+            np.abs(
+                np.asarray(params_t[k], np.float64)
+                - np.asarray(params_s[k], np.float64)
+            ).max()
+        )
+        for k in params_s
+    )
+    assert error / scale <= 5e-6, (
+        f"threaded vs SPMD buffered replay diverged: rel {error / scale:.3e}"
+    )
+
+
+# -------------------------------------------------- fusion + dispatch
+def test_buffered_fused_horizon_bit_exact_within_budget(tmp_path):
+    """Buffered semantics fuse: H=1 vs round_horizon=4 bit-exact (the
+    pending ring rides the scan carry across chunk boundaries), with the
+    fused trace holding ≤ 1 dispatch/round and ZERO retraces — asserted
+    through tracedump, the same gate test.sh runs."""
+    from tools.tracedump import check_budget, load_trace, summarize
+
+    base = dict(
+        round=4,
+        fault_tolerance=dict(STRAGGLERS),
+    )
+    _, _ = _run_spmd(
+        make_config(
+            tmp_path / "h1", algorithm_kwargs=dict(BUFFERED), **base
+        )
+    )
+    session, _ = _run_spmd(
+        make_config(
+            tmp_path / "h4",
+            algorithm_kwargs={**BUFFERED, "round_horizon": 4},
+            telemetry={"enabled": True},
+            **base,
+        )
+    )
+    _assert_bit_exact(
+        _final_params(tmp_path / "h1", 4), _final_params(tmp_path / "h4", 4)
+    )
+    assert session.dispatches_per_round <= 1.0
+    summary = summarize(
+        load_trace(str(tmp_path / "h4" / "server" / "trace.jsonl"))
+    )
+    assert not check_budget(
+        summary, ["dispatches_per_round<=1", "retrace_events==0"]
+    )
+    # the trace carries the buffered observability schema: one staleness
+    # event per late merge, one buffer_flush event per flush
+    assert summary["events"]["buffer_flush"] == 4
+    assert summary["staleness"]["count"] == 2
+    assert summary["staleness"]["p50"] == 1.0
+
+
+@pytest.mark.slow
+def test_buffered_gather_matches_dense(tmp_path):
+    """Selection-aware gather composes with the buffered replay: the
+    ``[s_pad]`` gathered rows and the dense ``[n_slots]`` rows train the
+    IDENTICAL trajectory (1 slot/device on the 8-worker test mesh).
+    Whole-run parity e2e — slow-marked for tier-1 headroom (the fused
+    test keeps the buffered dispatch machinery in the fast tier)."""
+    base = dict(
+        worker_number=8,
+        dataset_kwargs={"train_size": 128, "val_size": 16, "test_size": 32},
+        fault_tolerance=dict(STRAGGLERS),
+    )
+    for arm, gather in (("gather", True), ("dense", False)):
+        _run_spmd(
+            make_config(
+                tmp_path / arm,
+                algorithm_kwargs={
+                    **BUFFERED,
+                    "random_client_number": 5,
+                    "selection_gather": gather,
+                },
+                **base,
+            )
+        )
+    _assert_bit_exact(
+        _final_params(tmp_path / "gather", 3),
+        _final_params(tmp_path / "dense", 3),
+    )
+
+
+# ------------------------------------------------------------ rejection
+def test_buffered_rejected_loudly_off_the_fedavg_family(tmp_path):
+    """Config honesty: sessions without the buffered replay refuse the
+    knob with the capability-gate reason instead of silently dropping
+    it (the same strings tools/shardcheck reports at lint time)."""
+    from distributed_learning_simulator_tpu.parallel.spmd import (
+        SpmdSignSGDSession,
+    )
+    from distributed_learning_simulator_tpu.parallel.spmd_obd import (
+        SpmdFedOBDSession,
+    )
+    from distributed_learning_simulator_tpu.parallel.spmd_pp import (
+        SpmdPipelineSession,
+    )
+
+    assert SpmdFedAvgSession.capability_gates()["aggregation_mode"] is None
+    for cls in (SpmdFedOBDSession, SpmdPipelineSession):
+        assert "round-barriered" in cls.capability_gates()["aggregation_mode"]
+    assert (
+        "no round upload to buffer"
+        in SpmdSignSGDSession.capability_gates()["aggregation_mode"]
+    )
+    # the runtime gate raises from session __init__ on a subclass
+    config = make_config(
+        tmp_path,
+        distributed_algorithm="sign_SGD",
+        algorithm_kwargs=dict(BUFFERED),
+    )
+    ctx = _build_task(config)
+    with pytest.raises(ValueError, match="aggregation_mode"):
+        SpmdSignSGDSession(
+            ctx.config,
+            ctx.dataset_collection,
+            ctx.model_ctx,
+            ctx.engine,
+            ctx.practitioners,
+        )
+
+
+def test_buffered_settings_validation():
+    class Cfg:
+        algorithm_kwargs: dict = {}
+
+    cfg = Cfg()
+    cfg.algorithm_kwargs = {"aggregation_mode": "nonsense"}
+    with pytest.raises(ValueError, match="aggregation_mode"):
+        BufferedSettings.from_config(cfg)
+    cfg.algorithm_kwargs = {"aggregation_mode": "buffered", "buffer_size": -1}
+    with pytest.raises(ValueError, match="buffer_size"):
+        BufferedSettings.from_config(cfg)
+    cfg.algorithm_kwargs = {
+        "aggregation_mode": "buffered",
+        "staleness_alpha": -0.5,
+    }
+    with pytest.raises(ValueError, match="staleness_alpha"):
+        BufferedSettings.from_config(cfg)
+    # buffered knobs without the mode would be silent drops — rejected
+    cfg.algorithm_kwargs = {"buffer_size": 4}
+    with pytest.raises(ValueError, match="buffer_size"):
+        BufferedSettings.from_config(cfg)
+    cfg.algorithm_kwargs = {"aggregation_mode": "synchronous"}
+    assert BufferedSettings.from_config(cfg) is None
+    cfg.algorithm_kwargs = {}
+    assert BufferedSettings.from_config(cfg) is None
+
+
+# -------------------------------------------- per-client delay skew
+def test_straggler_delay_spread_is_seeded_and_bounded():
+    plan = FaultPlan.from_config(
+        type(
+            "Cfg",
+            (),
+            {
+                "fault_tolerance": {
+                    "seed": 3,
+                    "straggler_rate": 1.0,
+                    "straggler_delay_seconds": 2.0,
+                    "straggler_delay_spread": 1.5,
+                }
+            },
+        )()
+    )
+    delays = {
+        (r, w): plan.straggler_delay(r, w, 4)
+        for r in (1, 2)
+        for w in range(4)
+    }
+    # deterministic: a second draw is identical
+    for (r, w), delay in delays.items():
+        assert plan.straggler_delay(r, w, 4) == delay
+        assert 2.0 <= delay < 2.0 * 2.5
+        # staleness = ceil(delay / base): 1..3 at spread 1.5
+        staleness = plan.staleness_rounds(r, w, 4)
+        assert 1 <= staleness <= 3
+        assert staleness == int(np.ceil(delay / 2.0 - 1e-9))
+    # the spread actually spreads (not all multipliers equal)
+    assert len({round(d, 9) for d in delays.values()}) > 1
+    # spread 0 keeps the legacy constant delay and staleness exactly 1
+    flat_plan = FaultPlan.from_config(
+        type(
+            "Cfg",
+            (),
+            {
+                "fault_tolerance": {
+                    "straggler_rate": 1.0,
+                    "straggler_delay_seconds": 2.0,
+                }
+            },
+        )()
+    )
+    assert flat_plan.straggler_delay(1, 0, 4) == 2.0
+    assert flat_plan.staleness_rounds(1, 0, 4) == 1
+
+
+def test_straggler_delay_spread_unknown_key_strictness():
+    """The FaultPlan key set stays strict: the typo class still raises."""
+    with pytest.raises(ValueError, match="straggler_delay_spred"):
+        FaultPlan.from_config(
+            type(
+                "Cfg",
+                (),
+                {"fault_tolerance": {"straggler_delay_spred": 0.5}},
+            )()
+        )
+
+
+# ------------------------------------------------- pipeline guard unit
+def test_cross_stage_guard_matches_unsharded_verdict():
+    """The pipeline carve-out closure: guard_client_update's cross-stage
+    flavor (per-stage slice stats all-reduced along ``pp``) must return
+    the SAME verdict as the unsharded guard for finite, norm-exploded,
+    NaN-slice, NaN-replicated, and poisoned-weight clients — and the
+    verdict must be identical on every stage."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devices = jax.devices()[:2]
+    mesh = Mesh(np.asarray(devices), axis_names=("pp",))
+    globals_ = {
+        "trunk_w": jnp.zeros((2, 4), jnp.float32),
+        "head": jnp.zeros((3,), jnp.float32),
+    }
+    sharded = {"trunk_w": True, "head": False}
+
+    def cross_stage(params, weight):
+        def body(p, g, w):
+            eff, summed = guard_client_update(
+                p, g, w, {}, 3.0, sharded=sharded, reduce_axis="pp"
+            )
+            # each stage contributes its own verdict as one row, so the
+            # concatenated outputs PROVE the stages agreed
+            return (
+                jnp.reshape(eff, (1,)),
+                jnp.reshape(summed["rejected_updates"], (1,)),
+            )
+
+        eff_all, rej_all = shard_map_compat(
+            body,
+            mesh,
+            in_specs=(
+                {"trunk_w": P("pp"), "head": P()},
+                {"trunk_w": P("pp"), "head": P()},
+                P(),
+            ),
+            out_specs=(P("pp"), P("pp")),
+        )(params, globals_, jnp.float32(weight))
+        eff_all = np.asarray(eff_all)
+        rej_all = np.asarray(rej_all)
+        assert np.all(eff_all == eff_all[0]), "stages disagreed on eff"
+        assert np.all(rej_all == rej_all[0]), "stages disagreed on reject"
+        return float(eff_all[0]), float(rej_all[0])
+
+    cases = [
+        # (trunk delta, head delta, weight) — norm budget is 3.0
+        (np.full((2, 4), 0.5, np.float32), np.full(3, 0.5, np.float32), 2.0),
+        # norm explosion spread across BOTH stage slices (each slice's
+        # local norm is under budget — only the all-reduce catches it)
+        (np.full((2, 4), 1.2, np.float32), np.zeros(3, np.float32), 2.0),
+        # NaN confined to ONE stage's slice
+        (
+            np.concatenate(
+                [np.full((1, 4), np.nan, np.float32), np.zeros((1, 4), np.float32)]
+            ),
+            np.zeros(3, np.float32),
+            2.0,
+        ),
+        # NaN in a replicated leaf
+        (np.zeros((2, 4), np.float32), np.full(3, np.nan, np.float32), 2.0),
+        # poisoned weight (the corrupt-injection channel)
+        (np.zeros((2, 4), np.float32), np.zeros(3, np.float32), np.nan),
+    ]
+    for trunk, head, weight in cases:
+        params = {"trunk_w": jnp.asarray(trunk), "head": jnp.asarray(head)}
+        eff, rejected = cross_stage(params, weight)
+        ref_eff, ref_summed = guard_client_update(
+            params, globals_, jnp.float32(weight), {}, 3.0
+        )
+        assert eff == float(np.asarray(ref_eff)), (trunk[0, 0], weight)
+        assert rejected == float(
+            np.asarray(ref_summed["rejected_updates"])
+        ), (trunk[0, 0], weight)
+
+
+@pytest.mark.slow
+def test_pipeline_guard_rejects_corrupt_like_a_dropout(tmp_path):
+    """Whole-run pipeline guard e2e (the closed carve-out): a
+    NaN-corrupted client on the 2-stage pipeline session is rejected by
+    the cross-stage guard and the round is bit-exact with that client
+    simply dropping."""
+    from distributed_learning_simulator_tpu.training import (
+        _make_spmd_session,
+    )
+
+    def pp_config(save_dir, fault_tolerance):
+        return fed_avg_config(
+            dataset_name="imdb",
+            model_name="TransformerClassificationModel",
+            executor="spmd",
+            worker_number=2,
+            batch_size=4,
+            round=2,
+            epoch=1,
+            save_dir=str(save_dir),
+            log_file="",
+            dataset_kwargs={
+                "train_size": 16,
+                "val_size": 4,
+                "test_size": 8,
+                "max_len": 32,
+            },
+            model_kwargs={
+                "pipeline_stages": 2,
+                "d_model": 16,
+                "nhead": 2,
+                "num_encoder_layer": 2,
+                "max_len": 32,
+            },
+            fault_tolerance=fault_tolerance,
+        )
+
+    def run(config):
+        ctx = _build_task(config)
+        session = _make_spmd_session(ctx)
+        return session, session.run()
+
+    _, guarded = run(
+        pp_config(
+            tmp_path / "guard",
+            {"seed": 1, "corrupt_schedule": {2: [0]}, "update_guard": True},
+        )
+    )
+    assert guarded["performance"][2]["rejected_updates"] == 1
+    run(
+        pp_config(
+            tmp_path / "drop", {"seed": 1, "dropout_schedule": {2: [0]}}
+        )
+    )
+    _assert_bit_exact(
+        _final_params(tmp_path / "guard", 2),
+        _final_params(tmp_path / "drop", 2),
+    )
+
+
+# ----------------------------------------------------------- chaos axis
+@pytest.mark.slow
+def test_buffered_chaos_sweep_composes_on_both_executors(tmp_path):
+    """The new scenario axis: buffered × dropout × corrupt × guard ×
+    quorum, swept on BOTH executors — identical per-flush fault
+    accounting and final params in float32-summation agreement."""
+    fault_tolerance = {
+        "seed": 1,
+        "straggler_schedule": {1: [0]},
+        "dropout_schedule": {2: [1]},
+        "corrupt_schedule": {3: [2]},
+        "update_guard": True,
+    }
+    algorithm_kwargs = {**BUFFERED, "min_client_quorum": 1}
+    result_threaded = train(
+        make_config(
+            tmp_path / "threaded",
+            executor="sequential",
+            fault_tolerance=dict(fault_tolerance),
+            algorithm_kwargs=dict(algorithm_kwargs),
+        )
+    )
+    _, result_spmd = _run_spmd(
+        make_config(
+            tmp_path / "spmd",
+            round=4,
+            fault_tolerance=dict(fault_tolerance),
+            algorithm_kwargs=dict(algorithm_kwargs),
+        )
+    )
+    for round_number in (1, 2, 3):
+        row_t = result_threaded["performance"][round_number]
+        row_s = result_spmd["performance"][round_number]
+        for column in (
+            "flush_cohort",
+            "stale_updates",
+            "buffer_depth",
+            "rejected_updates",
+        ):
+            assert row_t[column] == row_s[column], (round_number, column)
+    # round 3's flush saw the corrupt upload rejected on both executors
+    assert result_threaded["performance"][3]["rejected_updates"] == 1
+    params_t = _final_params(tmp_path / "threaded", 3)
+    params_s = _final_params(tmp_path / "spmd", 3)
+    scale = max(
+        float(np.abs(np.asarray(v, np.float64)).max())
+        for v in params_s.values()
+    )
+    error = max(
+        float(
+            np.abs(
+                np.asarray(params_t[k], np.float64)
+                - np.asarray(params_s[k], np.float64)
+            ).max()
+        )
+        for k in params_s
+    )
+    assert error / scale <= 5e-6
+
+
+@pytest.mark.slow
+def test_buffered_corrupt_without_guard_poisons_visibly(tmp_path):
+    """Corrupt injection WITHOUT the update guard must never be
+    swallowed by a buffered flush: the NaN weight divides through and
+    the landing flush's params are visibly poisoned (the synchronous
+    SPMD semantics) — not a silent keep-the-old-params no-op."""
+    session, result = _run_spmd(
+        make_config(
+            tmp_path,
+            round=2,
+            # a straggler keeps the schedule depth ≥ 1 so the BUFFERED
+            # round program (not the depth-0 synchronous degenerate) is
+            # the one dividing through the NaN weight
+            fault_tolerance={
+                "seed": 1,
+                "straggler_schedule": {1: [0]},
+                "corrupt_schedule": {1: [1]},
+            },
+            algorithm_kwargs=dict(BUFFERED),
+        )
+    )
+    assert session._buffered_active
+    params = _final_params(tmp_path, 2)
+    assert any(
+        not np.all(np.isfinite(np.asarray(v))) for v in params.values()
+    ), "the poison vanished — a buffered flush silently kept old params"
+
+
+@pytest.mark.slow
+def test_buffered_quorum_aborts_loudly(tmp_path):
+    """An explicit min_client_quorum above a flush's surviving cohort
+    aborts loudly on the SPMD replay (the threaded server shares the
+    rule) — and records nothing degenerate first."""
+    from distributed_learning_simulator_tpu.util.faults import (
+        QuorumLostError,
+    )
+
+    config = make_config(
+        tmp_path,
+        fault_tolerance={"seed": 1, "straggler_schedule": {1: [0, 1, 2]}},
+        algorithm_kwargs={**BUFFERED, "min_client_quorum": 2},
+    )
+    with pytest.raises(QuorumLostError, match="min_client_quorum"):
+        _run_spmd(config)
+
+
+@pytest.mark.slow
+def test_buffered_threaded_resume_drains_the_buffer(tmp_path):
+    """A killed buffered run resumes cleanly: workers restart at the
+    resumed round, origin counters rebase there, and every pre-kill
+    scheduled item is cancelled — a flush must never wait on an upload
+    from before the kill (the deadlock class this pins).  The record
+    covers every round exactly once."""
+    from distributed_learning_simulator_tpu.training import (
+        train_with_recovery,
+    )
+
+    config = make_config(
+        tmp_path / "run",
+        executor="sequential",
+        round=4,
+        fault_tolerance={
+            "seed": 1,
+            "straggler_schedule": {1: [0], 3: [2]},
+            "kill_after_rounds": [2],
+            "max_restarts": 2,
+        },
+        algorithm_kwargs=dict(BUFFERED),
+    )
+    result = train_with_recovery(config, sleep_fn=lambda _s: None)
+    assert result["recovery"]["restarts"] == 1
+    assert sorted(result["performance"]) == [1, 2, 3, 4]
+    # post-resume flushes still ran the buffered machinery (round 4
+    # merges worker 2's stale round-3 upload)
+    assert result["performance"][4]["stale_updates"] == 1
+
+
+def test_buffered_record_rows_carry_flush_columns(tmp_path):
+    """Observability contract: buffered record rows (both executors
+    share the schema) carry flush_cohort / stale_updates / buffer_depth
+    next to the legacy columns."""
+    session, result = _run_spmd(
+        make_config(
+            tmp_path,
+            fault_tolerance=dict(STRAGGLERS),
+            algorithm_kwargs=dict(BUFFERED),
+        )
+    )
+    record_path = os.path.join(
+        str(tmp_path), "server", "round_record.json"
+    )
+    with open(record_path, encoding="utf8") as f:
+        rows = json.load(f)
+    for key, row in rows.items():
+        assert {"flush_cohort", "stale_updates", "buffer_depth"} <= set(
+            row
+        ), key
+    assert rows["2"]["stale_updates"] == 1
+    assert result["performance"][2]["flush_cohort"] == 4

@@ -143,6 +143,20 @@ def test_bench_main_prints_one_json_line(monkeypatch):
             "dropout_overhead_fraction": 0.02,
         },
     )
+    monkeypatch.setattr(
+        bench,
+        "measure_buffered_aggregation",
+        lambda: {
+            "model": "LeNet5/MNIST",
+            "executor": "sequential",
+            "rounds": bench.BUF_ROUNDS,
+            "barriered": {"seconds_per_round": 1.0},
+            "buffered": {"seconds_per_round": 0.6},
+            "buffered_speedup_fraction": 0.4,
+            "staleness_p50": 0.0,
+            "stale_updates_total": 5,
+        },
+    )
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
     bench.main()
@@ -173,6 +187,9 @@ def test_bench_main_prints_one_json_line(monkeypatch):
         "ep_fusion",
         "dropout_overhead_fraction",
         "fault_tolerance",
+        "buffered_speedup_fraction",
+        "staleness_p50",
+        "buffered_aggregation",
         "telemetry_overhead_fraction",
         "retrace_events",
         "telemetry",
@@ -217,6 +234,12 @@ def test_bench_main_prints_one_json_line(monkeypatch):
     # fraction mirrors the measurement's own field)
     assert payload["dropout_overhead_fraction"] == 0.02
     assert "masked" in payload["fault_tolerance"]
+    # buffered aggregation: the barriered-vs-buffered straggler A/B — a
+    # POSITIVE speedup fraction is the acceptance bar, surfaced at top
+    # level next to the schedule's median staleness
+    assert payload["buffered_speedup_fraction"] == 0.4
+    assert payload["staleness_p50"] == 0.0
+    assert "barriered" in payload["buffered_aggregation"]
     # roundtrace telemetry: the on-vs-off A/B surfaces its overhead
     # fraction and the trace's retrace count at top level
     assert payload["telemetry_overhead_fraction"] == 0.01
@@ -249,6 +272,7 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     monkeypatch.setattr(bench, "measure_ep_fusion", boom)
     monkeypatch.setattr(bench, "measure_selection_gather", boom)
     monkeypatch.setattr(bench, "measure_fault_tolerance", boom)
+    monkeypatch.setattr(bench, "measure_buffered_aggregation", boom)
     monkeypatch.setattr(bench, "measure_telemetry", boom)
     monkeypatch.setattr(bench, "measure_lint", boom)
     monkeypatch.setattr(bench, "measure_shardcheck", boom)
@@ -285,6 +309,11 @@ def test_bench_main_survives_measurement_failures(monkeypatch):
     # fraction degrades to -1 (the -1/absent-never contract)
     assert "error" in payload["fault_tolerance"]
     assert payload["dropout_overhead_fraction"] == -1.0
+    # buffered A/B degrades to an error marker; the top-level fields
+    # degrade to -1 (the -1/absent-never contract, both ways)
+    assert "error" in payload["buffered_aggregation"]
+    assert payload["buffered_speedup_fraction"] == -1.0
+    assert payload["staleness_p50"] == -1.0
     # telemetry A/B degrades the same way: error marker + -1 top-level
     # fields, never missing
     assert "error" in payload["telemetry"]

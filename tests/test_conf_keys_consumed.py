@@ -55,6 +55,17 @@ CONSUMERS: dict[tuple[str, str], list[str]] = {
         "parallel/spmd.py",
         "parallel/spmd_obd.py",
     ],
+    ("algorithm_kwargs", "aggregation_mode"): [
+        "util/buffered.py",
+        "server/aggregation_server.py",
+        "parallel/spmd.py",
+    ],
+    ("algorithm_kwargs", "buffer_size"): ["util/buffered.py"],
+    ("algorithm_kwargs", "staleness_alpha"): ["util/buffered.py"],
+    ("fault_tolerance", "seed"): ["util/faults.py"],
+    ("fault_tolerance", "straggler_rate"): ["util/faults.py"],
+    ("fault_tolerance", "straggler_delay_seconds"): ["util/faults.py"],
+    ("fault_tolerance", "straggler_delay_spread"): ["util/faults.py"],
     ("algorithm_kwargs", "share_feature"): [
         "worker/graph_worker.py",
         "parallel/spmd_gnn.py",

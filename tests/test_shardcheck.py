@@ -210,19 +210,77 @@ def _synthetic_config(**overrides):
     return config
 
 
-def test_pipeline_update_guard_pinned_to_fail_with_stated_reason():
-    """The pipeline session's guard carve-out surfaces at lint time with
-    the SAME reason its __init__ raises at round 1."""
+def test_pipeline_update_guard_now_validates_clean():
+    """The pipeline guard carve-out is CLOSED: guard_client_update's
+    cross-stage flavor (per-stage slice stats all-reduced along ``pp``)
+    made the last cell of the guard matrix real, so the conf validator
+    must stop flagging pipeline + update_guard."""
     config = _synthetic_config(
         model_kwargs={"pipeline_stages": 2},
         fault_tolerance={"update_guard": True},
     )
     findings = validate_config(config, "synthetic/pipeline_guard")
+    assert not any(
+        "update_guard" in f.message for f in findings
+    ), [f.as_dict() for f in findings]
+
+
+def test_buffered_aggregation_pinned_per_session():
+    """aggregation_mode=buffered validates clean on the client-axis
+    FedAvg family and fails at lint time everywhere else with the
+    session's honest runtime reason."""
+    clean = _synthetic_config(
+        algorithm_kwargs={"aggregation_mode": "buffered"},
+    )
+    assert validate_config(clean, "synthetic/buffered_ok") == []
+    for overrides, expect in (
+        (
+            dict(
+                distributed_algorithm="sign_SGD",
+                algorithm_kwargs={"aggregation_mode": "buffered"},
+            ),
+            "no round upload to buffer",
+        ),
+        (
+            dict(
+                model_kwargs={"pipeline_stages": 2},
+                algorithm_kwargs={"aggregation_mode": "buffered"},
+            ),
+            "still runs round-barriered",
+        ),
+        (
+            dict(
+                algorithm_kwargs={"aggregation_mode": "nonsense"},
+            ),
+            "aggregation_mode rejected",
+        ),
+        (
+            dict(
+                algorithm_kwargs={"buffer_size": 2},  # without the mode
+            ),
+            "aggregation_mode rejected",
+        ),
+    ):
+        config = _synthetic_config(**overrides)
+        findings = validate_config(config, "synthetic/buffered_bad")
+        assert any(expect in f.message for f in findings), (
+            expect,
+            [f.as_dict() for f in findings],
+        )
+
+
+def test_buffered_aggregation_threaded_algorithm_gate():
+    """On the threaded executor the buffered merge only exists for the
+    FedAvg family — a buffered smafd conf fails at lint time with the
+    server's reason."""
+    config = _synthetic_config(
+        distributed_algorithm="single_model_afd",
+        executor="sequential",
+        algorithm_kwargs={"aggregation_mode": "buffered"},
+    )
+    findings = validate_config(config, "synthetic/buffered_threaded")
     assert any(
-        f.rule == "conf-capability"
-        and "per-stage local" in f.message
-        and "SpmdPipelineSession" in f.message
-        for f in findings
+        "staleness-weightable" in f.message for f in findings
     ), [f.as_dict() for f in findings]
 
 
@@ -328,20 +386,25 @@ def test_capability_gates_match_runtime_gate_strings():
         "round_horizon": None,
         "selection_gather": None,
         "update_guard": None,
+        "aggregation_mode": None,
     }
-    assert SpmdFedOBDSession.capability_gates() == {
-        "round_horizon": None,
-        "selection_gather": None,
-        "update_guard": None,
-    }
+    obd = SpmdFedOBDSession.capability_gates()
+    assert obd["round_horizon"] is None
+    assert obd["selection_gather"] is None
+    assert obd["update_guard"] is None
+    assert "round-barriered" in obd["aggregation_mode"]
     pp = SpmdPipelineSession.capability_gates()
     assert pp["round_horizon"] is None
     assert pp["selection_gather"] is None
-    assert "per-stage local" in pp["update_guard"]
+    # the carve-out is closed: the cross-stage guard reduction made the
+    # last cell of the guard matrix real
+    assert pp["update_guard"] is None
+    assert "round-barriered" in pp["aggregation_mode"]
     smafd = SpmdSMAFDSession.capability_gates()
     assert "builds its own round function" in smafd["round_horizon"]
     assert "builds its own round program" in smafd["selection_gather"]
     assert "builds its own round program" in smafd["update_guard"]
+    assert "round-barriered" in smafd["aggregation_mode"]
 
 
 # --------------------------------------------------------- CLI/allowlist

@@ -24,7 +24,12 @@ REPO = os.path.dirname(
 )
 
 #: the knobs cross-validated against capability_gates
-GATED_KNOBS = ("round_horizon", "selection_gather", "update_guard")
+GATED_KNOBS = (
+    "round_horizon",
+    "selection_gather",
+    "update_guard",
+    "aggregation_mode",
+)
 
 
 def _layout_label(config) -> str:
@@ -72,13 +77,41 @@ def validate_config(config, subject: str) -> list[Finding]:
     except Exception as exc:  # noqa: BLE001 — misconfigured YAML
         flag(f"fault_tolerance rejected: {exc}")
         plan = None
+    # aggregation_mode / buffer_size / staleness_alpha are validated on
+    # BOTH executors — BufferedSettings.from_config is the config-honesty
+    # gate for the buffered knobs
+    from distributed_learning_simulator_tpu.util.buffered import (
+        BufferedSettings,
+    )
+
+    buffered = None
+    try:
+        buffered = BufferedSettings.from_config(config)
+    except Exception as exc:  # noqa: BLE001 — misconfigured YAML
+        flag(f"aggregation_mode rejected: {exc}")
     try:
         cls = resolve_spmd_session_class(config)
     except Exception as exc:  # noqa: BLE001 — invalid layout×method combo
         flag(str(exc))
         return findings
     if cls is None:
-        return findings  # threaded executor: the fused knobs don't apply
+        # threaded executor: the fused knobs don't apply, but buffered
+        # aggregation DOES run there — the server's own gate
+        # (util/buffered.py::threaded_buffered_reason, the single source
+        # AggregationServer.__init__ raises from) validates at lint time
+        if buffered is not None:
+            from distributed_learning_simulator_tpu.util.buffered import (
+                threaded_buffered_reason,
+            )
+
+            reason = threaded_buffered_reason(config.distributed_algorithm)
+            if reason is not None:
+                flag(
+                    "aggregation_mode=buffered on the threaded"
+                    f" {config.distributed_algorithm!r} server: {reason}"
+                    " — the server __init__ raises"
+                )
+        return findings
     gates = _gates_for(cls)
     kwargs = dict(config.algorithm_kwargs or {})
 
@@ -112,6 +145,12 @@ def validate_config(config, subject: str) -> list[Finding]:
         flag(
             f"fault_tolerance.update_guard on {cls.__name__}:"
             f" {gates['update_guard']} — session __init__ raises"
+        )
+
+    if buffered is not None and gates.get("aggregation_mode"):
+        flag(
+            f"aggregation_mode=buffered on {cls.__name__}:"
+            f" {gates['aggregation_mode']} — session __init__ raises"
         )
 
     quorum = int(kwargs.get("min_client_quorum", 0) or 0)

@@ -111,6 +111,7 @@ def summarize(records: list[dict]) -> dict[str, Any]:
     received_mb = 0.0
     rejected = 0.0
     dropped = 0.0
+    staleness_vals: list[float] = []
     meta: dict = {}
     for record in records:
         ev = record.get("ev")
@@ -139,6 +140,11 @@ def summarize(records: list[dict]) -> dict[str, Any]:
             elif kind == "fault":
                 rejected += float(record.get("rejected_updates", 0) or 0)
                 dropped += float(record.get("dropped_clients", 0) or 0)
+            elif kind == "staleness":
+                # one event per late-merged update under buffered
+                # aggregation (threaded flushes AND the SPMD replay emit
+                # the identical schema)
+                staleness_vals.append(float(record.get("staleness", 0) or 0))
 
     span_stats: dict[str, dict] = {}
     for kind, durations in spans.items():
@@ -167,7 +173,9 @@ def summarize(records: list[dict]) -> dict[str, Any]:
         "received_mb_total": round(received_mb, 6),
         "rejected_updates_total": rejected,
         "dropped_clients_total": dropped,
+        "stale_updates_total": float(len(staleness_vals)),
     }
+    ordered_staleness = sorted(staleness_vals)
     return {
         "meta": meta,
         "records": len(records),
@@ -175,6 +183,14 @@ def summarize(records: list[dict]) -> dict[str, Any]:
         "events": events,
         "programs": programs,
         "budget": budget,
+        # buffered aggregation: distribution of merged updates' staleness
+        # (bench surfaces staleness_p50 from the same rule)
+        "staleness": {
+            "count": len(ordered_staleness),
+            "p50": _percentile(ordered_staleness, 0.50),
+            "p90": _percentile(ordered_staleness, 0.90),
+            "max": ordered_staleness[-1] if ordered_staleness else 0.0,
+        },
     }
 
 
@@ -291,4 +307,12 @@ def format_text(summary: dict) -> str:
         f"rejected_updates={budget['rejected_updates_total']:g} "
         f"dropped_clients={budget['dropped_clients_total']:g}"
     )
+    staleness = summary.get("staleness") or {}
+    if staleness.get("count"):
+        lines.append(
+            "staleness (buffered): "
+            f"late_merges={staleness['count']} "
+            f"p50={staleness['p50']:g} p90={staleness['p90']:g} "
+            f"max={staleness['max']:g}"
+        )
     return "\n".join(lines)
