@@ -469,6 +469,15 @@ class TraceCounterMixin:
     def reset_dispatch_stats(self) -> None:
         self._trace.reset_counters("dispatch", "host_sync", "rounds")
 
+    def cost_ledger(self) -> dict[str, dict[str, float]]:
+        """Price every program this session would dispatch — the
+        ``shardcheck_programs()`` inventory AOT-lowered and compiled
+        under each spec's mesh context, nothing executed (the costwatch
+        ledger; ``tools/costview --ledger`` and bench read it)."""
+        from ..util.costwatch import session_cost_ledger
+
+        return session_cost_ledger(self)
+
     def _trace_fault_event(
         self, round_number: int, rejected, selected=None
     ) -> None:
@@ -579,9 +588,17 @@ class SpmdFedAvgSession(TraceCounterMixin):
         slot_axes = tuple(a for a in slot_axes if a in self.mesh.shape)
         self.n_slots = client_slots(config.worker_number, self.mesh, slot_axes)
         self.quantization_level = quantization_level
-        self.client_chunk = client_chunk or int(
-            config.algorithm_kwargs.get("client_chunk", 0)
+        # ``client_chunk: auto`` resolves from the tools/autotune
+        # calibration cache — but the key needs ``s_pad``, so the value
+        # is parsed here and resolved a few lines down, once the
+        # selection-gather geometry is known
+        raw_chunk = client_chunk or config.algorithm_kwargs.get(
+            "client_chunk", 0
         )
+        self._client_chunk_auto = (
+            isinstance(raw_chunk, str) and raw_chunk.strip().lower() == "auto"
+        )
+        self.client_chunk = 0 if self._client_chunk_auto else int(raw_chunk or 0)
         # ---- selection-aware gather: O(selected) round compute ----
         # Under partial participation the dense round program trains every
         # one of the ``n_slots`` client slots and zero-masks the unselected
@@ -630,6 +647,16 @@ class SpmdFedAvgSession(TraceCounterMixin):
             if self._selection_gather
             else self.n_slots
         )
+        if self._client_chunk_auto:
+            # cache hit -> the calibrated winner, indistinguishable from
+            # the same constant set by hand; miss -> 0 (the hand-set
+            # default heuristic in ``chunk_size``) after a loud warning
+            from ..util.calibration import resolve_client_chunk
+
+            self.client_chunk = resolve_client_chunk(
+                self,
+                path=config.algorithm_kwargs.get("calibration_path"),
+            )
         # ---- fault tolerance (util/faults.py) ----
         # The availability mask rides the SAME host-built weight rows
         # selection does (a dropped client's weight is zeroed, a corrupt
@@ -1913,15 +1940,14 @@ class SpmdFedAvgSession(TraceCounterMixin):
             )  # [C, n_batches, B, ...] -> one [B, ...] batch
             opt_state = engine.optimizer.init(global_params)
             rng = jax.random.PRNGKey(0)
+            from ..util.costwatch import cost_summary
+
             compiled = (
                 jax.jit(engine.train_step_fn)
                 .lower(global_params, opt_state, batch, rng)
                 .compile()
             )
-            cost = compiled.cost_analysis()
-            if isinstance(cost, (list, tuple)):
-                cost = cost[0] if cost else {}
-            step_flops = float(cost.get("flops", 0.0))
+            step_flops = cost_summary(compiled)["flops"]
             # MFU honesty: price only the clients whose contribution can
             # reach the aggregate — min(worker_number, random_client_number)
             # — so the dense path's zero-weight slot compute is WASTE, not
@@ -2567,6 +2593,7 @@ class SpmdFedAvgSession(TraceCounterMixin):
                     "dispatch", program="eval", round=round_number
                 )
                 self._trace.event("host_sync", round=round_number)
+                self._trace.hbm_watermark(round_number)
                 self._trace.count("rounds")
                 # same stat surface as the threaded server: analytic wire
                 # cost (what the aggregation consumed over ICI, priced at
@@ -2687,6 +2714,7 @@ class SpmdFedAvgSession(TraceCounterMixin):
                     else None
                 )
                 self._trace.event("host_sync", round=boundary)
+                self._trace.hbm_watermark(boundary)
                 chunk_seconds = _time.monotonic() - start
                 self._trace.span_record(
                     "horizon",
@@ -3586,6 +3614,7 @@ class SpmdSignSGDSession(TraceCounterMixin):
                 )
             self._trace.event("dispatch", program="eval", round=round_number)
             self._trace.event("host_sync", round=round_number)
+            self._trace.hbm_watermark(round_number)
             self._trace.count("rounds")
             self._note_round(
                 round_number,
@@ -3688,6 +3717,7 @@ class SpmdSignSGDSession(TraceCounterMixin):
             per_round = stacked_round_metrics(outs[1])
             confusion = np.asarray(outs[2]) if len(outs) > 2 else None
             self._trace.event("host_sync", round=boundary)
+            self._trace.hbm_watermark(boundary)
             chunk_seconds = _time.monotonic() - chunk_start
             self._trace.span_record(
                 "horizon",

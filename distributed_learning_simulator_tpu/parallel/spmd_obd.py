@@ -1279,6 +1279,7 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                         )  # phase 2: check_acc semantics
                     self._trace.event("dispatch", program="eval", round=key)
                     self._trace.event("host_sync", round=key)
+                    self._trace.hbm_watermark(key)
                     self._trace.count("rounds")
                     self._trace_fault_event(
                         key,
@@ -1347,6 +1348,7 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                     per_round = stacked_round_metrics(outs[1])
                     confusion = np.asarray(outs[2]) if len(outs) > 2 else None
                     self._trace.event("host_sync", round=keys[-1])
+                    self._trace.hbm_watermark(keys[-1])
                     chunk_seconds = _time.monotonic() - chunk_start
                     self._trace.span_record(
                         "horizon",

@@ -1,0 +1,118 @@
+"""client_chunk calibration cache: the read side of ``tools/autotune``.
+
+``algorithm_kwargs.client_chunk`` has been a hand-set constant since
+PR 3 (8 on the large-scale bench shape, divisor-clamped in
+``chunk_size``).  ``tools/autotune`` measures the actual sweep on the
+actual hardware and writes ``calibration.json`` at the repo root (the
+same committed-but-machine-refreshed pattern as ``bench_baseline.json``);
+sessions setting ``client_chunk: auto`` consult it here.
+
+The cache key pins everything that changes the round program's chunking
+trade-off: session class, model, device mesh, slot count (with
+padding), and batch size.  A miss is LOUD — one warning naming the key,
+then fallback to ``client_chunk: 0``, i.e. exactly the hand-set-default
+heuristic path (8 on TPU, all slots otherwise), so ``auto`` without a
+cache entry behaves identically to not setting the knob at all.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from ..utils.logging import get_logger
+
+CALIBRATION_VERSION = 1
+
+#: repo-root default, next to bench_baseline.json
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_CALIBRATION_PATH = os.path.join(_REPO_ROOT, "calibration.json")
+
+
+def calibration_key(
+    session: str,
+    model_name: str,
+    mesh_shape: dict[str, int] | None,
+    n_slots: int,
+    s_pad: int,
+    batch_size: int,
+) -> str:
+    """The canonical cache key — autotune's writer and the session's
+    reader MUST build it through this one function."""
+    mesh = ",".join(f"{k}={v}" for k, v in sorted((mesh_shape or {}).items()))
+    return (
+        f"{session}|{model_name}|mesh[{mesh}]|slots={n_slots}"
+        f"|s_pad={s_pad}|batch={batch_size}"
+    )
+
+
+def session_calibration_key(session_obj) -> str:
+    """Key for a live session object (reader side)."""
+    mesh = getattr(session_obj, "mesh", None)
+    mesh_shape = dict(mesh.shape) if mesh is not None else {}
+    return calibration_key(
+        session=type(session_obj).__name__,
+        model_name=getattr(session_obj.config, "model_name", ""),
+        mesh_shape=mesh_shape,
+        n_slots=int(getattr(session_obj, "n_slots", 0)),
+        s_pad=int(getattr(session_obj, "s_pad", 0)),
+        batch_size=int(getattr(session_obj.config, "batch_size", 0)),
+    )
+
+
+def load_calibration(path: str | None = None) -> dict[str, Any]:
+    """Parse the cache (``{}`` when absent/unreadable — resolution then
+    falls back loudly)."""
+    path = path or DEFAULT_CALIBRATION_PATH
+    try:
+        with open(path, encoding="utf8") as f:
+            blob = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return blob if isinstance(blob, dict) else {}
+
+
+def save_calibration_entry(
+    key: str, entry: dict[str, Any], path: str | None = None
+) -> str:
+    """Merge one sweep result into the cache file (autotune's writer;
+    whole-file rewrite, stable key order for reviewable diffs)."""
+    path = path or DEFAULT_CALIBRATION_PATH
+    blob = load_calibration(path)
+    blob.setdefault("version", CALIBRATION_VERSION)
+    entries = blob.setdefault("entries", {})
+    entries[key] = entry
+    blob["entries"] = dict(sorted(entries.items()))
+    with open(path, "w", encoding="utf8") as f:
+        json.dump(blob, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def resolve_client_chunk(session_obj, path: str | None = None) -> int:
+    """``client_chunk: auto`` → a concrete chunk for this session shape.
+
+    Cache hit returns the calibrated winner (an int the downstream
+    ``chunk_size`` divisor-clamp treats exactly like a hand-set value —
+    the bit-exactness pin).  Miss returns 0 (the hand-set-default
+    heuristic) after one loud warning."""
+    key = session_calibration_key(session_obj)
+    entry = load_calibration(path).get("entries", {}).get(key)
+    if entry is not None:
+        chunk = int(entry.get("client_chunk", 0) or 0)
+        if chunk > 0:
+            get_logger().info(
+                "client_chunk: auto -> %d (calibration %r)", chunk, key
+            )
+            return chunk
+    get_logger().warning(
+        "client_chunk: auto found NO calibration entry for %r in %s — "
+        "falling back to the hand-set default heuristic (run "
+        "`python -m tools.autotune` to calibrate this shape)",
+        key,
+        path or DEFAULT_CALIBRATION_PATH,
+    )
+    return 0

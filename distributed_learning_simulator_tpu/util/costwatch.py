@@ -1,0 +1,306 @@
+"""costwatch: compiled cost/memory attribution — the ledger single
+source behind ``program_cost`` trace events, ``session.cost_ledger()``,
+``tools/costview``, and bench MFU.
+
+The repo had four independent call sites poking
+``compiled.cost_analysis()`` / ``compiled.memory_analysis()`` (bench's
+dense/large-scale/long-context measurements plus
+``spmd.round_flops``), each re-deriving the same normalization dance —
+XLA returns ``cost_analysis()`` as a dict on some backends and a
+one-element list of dicts on others, and ``memory_analysis()`` is a
+``CompiledMemoryStats`` with ``*_size_in_bytes`` attributes that may be
+absent entirely.  This module is the one place that dance lives:
+
+* :func:`cost_summary` — a compiled executable → the flat ledger schema
+  (``flops`` / ``bytes_accessed`` / ``argument_bytes`` /
+  ``output_bytes`` / ``temp_bytes`` / ``generated_code_bytes``);
+* :func:`program_cost` — a jitted fn + (possibly donated) example args
+  → the same schema via a metadata-only AOT ``lower().compile()``
+  (shape/dtype/sharding survive donation, and jit's executable cache
+  makes the second compile free);
+* :func:`session_cost_ledger` — walk a session's
+  ``shardcheck_programs()`` inventory (PR 9) and price every program it
+  would dispatch, abstract args only, nothing executed;
+* :func:`roofline` — arithmetic intensity vs the peak-FLOP/s and
+  HBM-bandwidth tables → compute- vs HBM-bound classification and
+  achieved-vs-roofline MFU (``tools/costview`` renders this);
+* :func:`hlo_op_histogram` — opcode-level output-bytes histogram over
+  the optimized HLO, the attribution view that names WHICH op family
+  eats the round (``docs/cost_attribution_large_scale.md``).
+
+House rules: pure host-side metadata — no dispatches, no host syncs, no
+device-array reads; every function that rides a hot path
+(:func:`program_cost` from the telemetry dispatch tail) swallows its
+own failures, because diagnostics must never take down a run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import re
+from typing import Any, Iterable
+
+#: per-chip bf16 peak FLOP/s by device kind (MFU denominator; moved
+#: here from bench.py so bench and costview can never disagree)
+BF16_PEAK = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+#: per-chip HBM bandwidth (bytes/s) by device kind — the roofline's
+#: memory ceiling (public chip specs: v4 1.23 TB/s, v5e 0.82, v5p 2.77,
+#: v6e 1.64)
+HBM_BANDWIDTH = {
+    "TPU v4": 1.23e12,
+    "TPU v5 lite": 0.82e12,
+    "TPU v5e": 0.82e12,
+    "TPU v5": 2.77e12,
+    "TPU v5p": 2.77e12,
+    "TPU v6 lite": 1.64e12,
+    "TPU v6e": 1.64e12,
+}
+
+#: the flat per-program ledger schema (``program_cost`` trace events,
+#: ``cost_ledger()`` values, costview rows all share it)
+LEDGER_FIELDS = (
+    "flops",
+    "bytes_accessed",
+    "argument_bytes",
+    "output_bytes",
+    "temp_bytes",
+    "generated_code_bytes",
+)
+
+
+def _match_chip(table: dict[str, float]) -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    # longest prefix first: 'TPU v5 lite' must win over 'TPU v5'
+    for name in sorted(table, key=len, reverse=True):
+        if kind.startswith(name):
+            return table[name] * len(jax.devices())
+    return 0.0
+
+
+def chip_peak_flops() -> float:
+    """Aggregate bf16 peak FLOP/s across the visible devices (0.0 on an
+    unknown chip — CPU benches report MFU 0 rather than a lie)."""
+    return _match_chip(BF16_PEAK)
+
+
+def chip_hbm_bandwidth() -> float:
+    """Aggregate HBM bandwidth (bytes/s) across the visible devices
+    (0.0 on an unknown chip)."""
+    return _match_chip(HBM_BANDWIDTH)
+
+
+# ---------------------------------------------------------------- ledger
+def normalize_cost(cost: Any) -> dict[str, float]:
+    """``cost_analysis()`` → ``{"flops": ..., "bytes_accessed": ...}``.
+
+    XLA returns either a dict or a list with one dict per computation
+    (CPU PJRT does the latter); absent keys read 0.0."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    if not isinstance(cost, dict):
+        cost = {}
+    return {
+        "flops": float(cost.get("flops", 0.0) or 0.0),
+        "bytes_accessed": float(cost.get("bytes accessed", 0.0) or 0.0),
+    }
+
+
+def cost_summary(compiled) -> dict[str, float]:
+    """A compiled executable → the flat :data:`LEDGER_FIELDS` schema.
+
+    Either analysis may be unimplemented on a backend; each side
+    degrades to zeros independently so the other still reports."""
+    out = dict.fromkeys(LEDGER_FIELDS, 0.0)
+    try:
+        out.update(normalize_cost(compiled.cost_analysis()))
+    except Exception:  # noqa: BLE001 — backend-optional analysis
+        pass
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001
+        mem = None
+    if mem is not None:
+        for field, attr in (
+            ("argument_bytes", "argument_size_in_bytes"),
+            ("output_bytes", "output_size_in_bytes"),
+            ("temp_bytes", "temp_size_in_bytes"),
+            ("generated_code_bytes", "generated_code_size_in_bytes"),
+        ):
+            out[field] = float(getattr(mem, attr, 0) or 0)
+    return out
+
+
+def abstract_args(args):
+    """Pytree of (possibly donated) arrays → matching
+    ``ShapeDtypeStruct`` tree, shardings preserved.  Donation reclaims
+    the buffer but never the aval, so this is safe at a dispatch tail;
+    non-array leaves pass through untouched."""
+    import jax
+
+    def _leaf(x):
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is None or dtype is None:
+            return x
+        sharding = getattr(x, "sharding", None)
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+        except Exception:  # noqa: BLE001 — e.g. a non-jax ndarray leaf
+            return jax.ShapeDtypeStruct(shape, dtype)
+
+    return jax.tree.map(_leaf, args)
+
+
+def program_cost(jitted, args) -> dict[str, float] | None:
+    """Price one jitted program from its example args via AOT
+    ``lower().compile()`` on the ABSTRACT signature — no execution, and
+    after the jit call that triggered capture the executable comes from
+    jit's own cache, so the only real cost is one bounded re-lowering
+    per program.  Must run under the same mesh context as the dispatch
+    (the telemetry tail already is).  Returns None on any failure:
+    diagnostics must never raise."""
+    try:
+        return cost_summary(jitted.lower(*abstract_args(args)).compile())
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def session_cost_ledger(session) -> dict[str, dict[str, float]]:
+    """Price every program a session would dispatch, derived from its
+    ``shardcheck_programs()`` inventory (PR 9): per spec, enter its mesh
+    context and AOT-compile the already-abstract args — the exact
+    lowering ``tools/shardcheck`` certifies, now priced.  Returns
+    ``{program_name: ledger row}``; a session without the introspection
+    hook yields ``{}``."""
+    programs_fn = getattr(session, "shardcheck_programs", None)
+    if programs_fn is None:
+        return {}
+    ledger: dict[str, dict[str, float]] = {}
+    for spec in programs_fn():
+        ctx = (
+            spec.mesh_context()
+            if getattr(spec, "mesh_context", None) is not None
+            else contextlib.nullcontext()
+        )
+        with ctx:
+            compiled = spec.jitted.lower(*spec.args).compile()
+        row = cost_summary(compiled)
+        scanned = int(getattr(spec, "scanned_len", 0) or 0)
+        if scanned:
+            # XLA prices a scan body ONCE, not × trip count — record the
+            # trip count so consumers can surface totals honestly
+            row["scanned_len"] = scanned
+        ledger[spec.name] = row
+    return ledger
+
+
+# -------------------------------------------------------------- roofline
+def roofline(
+    flops: float,
+    bytes_accessed: float,
+    seconds: float = 0.0,
+    peak_flops: float = 0.0,
+    hbm_bandwidth: float = 0.0,
+) -> dict[str, Any]:
+    """Classic roofline attribution for one program, all host-f64:
+
+    * ``arithmetic_intensity`` = flops / bytes accessed;
+    * ``ridge_intensity`` = peak FLOP/s / HBM bytes/s — above it the
+      roof is compute, below it HBM;
+    * ``bound_by`` ∈ ``compute`` / ``hbm`` / ``unknown`` (no tables for
+      this chip);
+    * ``roofline_flops_per_s`` = min(peak, intensity × bandwidth) and
+      ``roofline_mfu`` — the best this program could do on this chip;
+    * with ``seconds`` > 0: ``achieved_flops_per_s``, ``achieved_mfu``,
+      and ``fraction_of_roofline`` (achieved / attainable)."""
+    intensity = flops / bytes_accessed if bytes_accessed > 0 else 0.0
+    out: dict[str, Any] = {
+        "arithmetic_intensity": intensity,
+        "bound_by": "unknown",
+        "ridge_intensity": 0.0,
+        "roofline_flops_per_s": 0.0,
+        "roofline_mfu": 0.0,
+    }
+    if peak_flops > 0 and hbm_bandwidth > 0:
+        ridge = peak_flops / hbm_bandwidth
+        attainable = min(peak_flops, intensity * hbm_bandwidth)
+        out["ridge_intensity"] = ridge
+        out["bound_by"] = "compute" if intensity >= ridge else "hbm"
+        out["roofline_flops_per_s"] = attainable
+        out["roofline_mfu"] = attainable / peak_flops
+    if seconds > 0.0:
+        achieved = flops / seconds
+        out["achieved_flops_per_s"] = achieved
+        if peak_flops > 0:
+            out["achieved_mfu"] = achieved / peak_flops
+        if out["roofline_flops_per_s"] > 0:
+            out["fraction_of_roofline"] = achieved / out["roofline_flops_per_s"]
+    return out
+
+
+# -------------------------------------------------- HLO op attribution
+_HLO_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*"
+    r"(?P<ty>[a-z0-9]+)\[(?P<shape>[\d,]*)\][^=]*?\s"
+    r"(?P<op>[a-zA-Z\-]+)\("
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+
+def hlo_op_histogram(hlo_text: str, top: int = 0) -> list[dict[str, Any]]:
+    """Opcode histogram over optimized HLO text (``compiled.as_text()``):
+    per opcode, instruction count and summed output bytes, sorted by
+    output bytes descending.  ``cost_analysis`` only gives program
+    totals — this is the view that names the top non-matmul consumer.
+    Fusions keep their ``kind=`` label (``fusion:kLoop`` etc.) so loop
+    fusions and output fusions attribute separately."""
+    agg: dict[str, dict[str, float]] = {}
+    for line in hlo_text.splitlines():
+        m = _HLO_OP_RE.match(line)
+        if m is None:
+            continue
+        op = m["op"]
+        if op == "fusion":
+            kind_m = re.search(r"kind=(k\w+)", line)
+            if kind_m:
+                op = f"fusion:{kind_m[1]}"
+        dims = [int(d) for d in m["shape"].split(",") if d]
+        numel = 1
+        for d in dims:
+            numel *= d
+        out_bytes = numel * _DTYPE_BYTES.get(m["ty"], 4)
+        row = agg.setdefault(op, {"count": 0, "output_bytes": 0.0})
+        row["count"] += 1
+        row["output_bytes"] += float(out_bytes)
+    ordered = [
+        {"op": op, **row}
+        for op, row in sorted(
+            agg.items(), key=lambda kv: -kv[1]["output_bytes"]
+        )
+    ]
+    return ordered[:top] if top else ordered
+
+
+def merge_ledgers(rows: Iterable[dict[str, float]]) -> dict[str, float]:
+    """Sum ledger rows field-wise (totals line for costview tables)."""
+    total = dict.fromkeys(LEDGER_FIELDS, 0.0)
+    for row in rows:
+        for field in LEDGER_FIELDS:
+            total[field] += float(row.get(field, 0.0) or 0.0)
+    return total

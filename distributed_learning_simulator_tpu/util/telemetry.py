@@ -40,6 +40,11 @@ Config surface (``config.telemetry``, unknown keys raise like
                              # relative paths anchor there too
       flush_every: 256       # records buffered between appends (0=auto)
       capture_compile: true  # log a `compile` event when a jit cache grows
+      capture_cost: true     # price each program at its compile event
+                             # (costwatch ledger -> `program_cost` events)
+      capture_hbm: true      # sample device.memory_stats() watermarks at
+                             # round boundaries (`hbm` events; silently
+                             # absent on backends that return None)
       profile_rounds: [3, 5] # wrap rounds 3..5 in a jax.profiler trace
 
 Record schema (one JSON object per line; ``tools/tracedump`` documents
@@ -54,7 +59,18 @@ the derived summary):
 * ``compile`` events carry ``program``, ``cache_size``, ``retrace``
   (True when the cache grew past its first entry — the dispatch-budget
   invariant shardcheck certifies statically, observed at runtime) and
-  the abstract ``signature`` that triggered the trace.
+  the abstract ``signature`` that triggered the trace;
+* ``program_cost`` events (PR 13 costwatch) carry the flat ledger
+  schema (``flops``/``bytes_accessed``/``argument_bytes``/
+  ``output_bytes``/``temp_bytes``/``generated_code_bytes``) priced via
+  a metadata-only AOT relowering at the same compile event — one
+  bounded extra compile per program, zero dispatches;
+* ``dispatch_call`` spans time the host-blocking portion of each jitted
+  call (``tools/costview`` subtracts their sum from the round span to
+  expose the host gap);
+* ``hbm`` events sample ``device.memory_stats()`` live/peak bytes at
+  round boundaries (absent on backends whose PJRT client returns None,
+  e.g. CPU).
 """
 
 from __future__ import annotations
@@ -66,7 +82,15 @@ import time
 from typing import Any
 
 _KNOWN_KEYS = frozenset(
-    ("enabled", "path", "flush_every", "capture_compile", "profile_rounds")
+    (
+        "enabled",
+        "path",
+        "flush_every",
+        "capture_compile",
+        "capture_cost",
+        "capture_hbm",
+        "profile_rounds",
+    )
 )
 
 #: schema version stamped into the meta record
@@ -153,6 +177,8 @@ class TraceRecorder:
         path: str | None = None,
         flush_every: int = 0,
         capture_compile: bool = True,
+        capture_cost: bool = True,
+        capture_hbm: bool = True,
         profile_rounds: tuple[int, int] | None = None,
         meta: dict[str, Any] | None = None,
     ) -> None:
@@ -160,6 +186,8 @@ class TraceRecorder:
         self.path = path
         self.flush_every = int(flush_every) or 256
         self.capture_compile = bool(capture_compile)
+        self.capture_cost = bool(capture_cost)
+        self.capture_hbm = bool(capture_hbm)
         self.profile_rounds = profile_rounds
         self.counters: dict[str, int] = {}
         self._origin = time.monotonic()
@@ -246,6 +274,8 @@ class TraceRecorder:
             path=path,
             flush_every=int(raw.get("flush_every", 0) or 0),
             capture_compile=bool(raw.get("capture_compile", True)),
+            capture_cost=bool(raw.get("capture_cost", True)),
+            capture_hbm=bool(raw.get("capture_hbm", True)),
             profile_rounds=window,
             meta=meta,
         )
@@ -312,15 +342,27 @@ class TraceRecorder:
         should report; shape/dtype metadata is all that is read, and
         only when the cache actually grew — donated buffers keep their
         metadata after donation, so this tail never touches reclaimed
-        memory."""
+        memory.  When enabled, the call is timed into a
+        ``dispatch_call`` span (the host-blocking portion — on an async
+        backend the remaining device time lands at the round's ONE
+        existing sync point) and the full ``args`` feed the costwatch
+        ledger at compile events."""
+        if not self.enabled:
+            return jitted(*args)
+        start = time.monotonic()
         out = jitted(*args)
-        if self.enabled:
-            self.note_compile(
-                program, jitted, args if sig_args is None else sig_args
-            )
+        self.span_record(
+            "dispatch_call", time.monotonic() - start, program=program
+        )
+        self.note_compile(
+            program,
+            jitted,
+            args if sig_args is None else sig_args,
+            cost_args=args,
+        )
         return out
 
-    def note_compile(self, program: str, jitted, args=None) -> None:
+    def note_compile(self, program: str, jitted, args=None, cost_args=None) -> None:
         """Log a ``compile`` event whenever ``jitted``'s cache grew since
         the last dispatch of ``program`` — the dispatch-budget invariant
         (shardcheck's static ``dispatch-budget`` rule) turned into a
@@ -355,6 +397,50 @@ class TraceRecorder:
             },
         )
         self.count("compile")
+        if self.capture_cost and cost_args is not None:
+            self.note_program_cost(program, jitted, cost_args)
+
+    def note_program_cost(self, program: str, jitted, args) -> None:
+        """Price ``program`` into a ``program_cost`` event via the
+        costwatch ledger (metadata-only AOT relowering under the
+        caller's ambient mesh context — the dispatch tail runs inside
+        the session's mesh scope).  Compile events are rare (once per
+        program on the no-retrace invariant), so the one bounded extra
+        compile this costs never rides the steady-state round."""
+        if not (self.enabled and self.capture_cost):
+            return
+        from .costwatch import program_cost
+
+        row = program_cost(jitted, args)
+        if row is not None:
+            self._emit("event", "program_cost", {"program": program, **row})
+
+    def hbm_watermark(self, round_number: int) -> None:
+        """Sample ``device.memory_stats()`` live/peak bytes into one
+        ``hbm`` event — called at round boundaries the run loops already
+        own (a PJRT client host query: no dispatch, no device sync).
+        Backends whose client returns None (CPU) emit nothing."""
+        if not (self.enabled and self.capture_hbm):
+            return
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:  # noqa: BLE001 — diagnostics must never raise
+            return
+        if not stats:
+            return
+        self._emit(
+            "event",
+            "hbm",
+            {
+                "round": int(round_number),
+                "bytes_in_use": int(stats.get("bytes_in_use", 0) or 0),
+                "peak_bytes_in_use": int(
+                    stats.get("peak_bytes_in_use", 0) or 0
+                ),
+            },
+        )
 
     # ---------------------------------------------------- profiler window
     def maybe_profile_start(self, first_round: int, last_round: int | None = None) -> None:

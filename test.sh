@@ -121,6 +121,13 @@ python3 -m tools.tracedump "$TRACE_SMOKE/buffered_sequential/server/trace.jsonl"
 python3 -m tools.tracedump "$TRACE_SMOKE/spmd/server/trace.jsonl" \
   --assert-budget "dispatches_per_round<=1" \
   --assert-budget "retrace_events==0"
+# costwatch gate (tools/costview): the same fused smoke trace must hold
+# the MEMORY budget — program temporaries (~12 MB on this shape; bound
+# is generous headroom, a regression shows up as an order of magnitude)
+# and the peak HBM watermark (0 on CPU hosts, sampled live on TPU)
+python3 -m tools.costview "$TRACE_SMOKE/spmd/server/trace.jsonl" \
+  --assert-budget "temp_bytes<=200000000" \
+  --assert-budget "peak_hbm_bytes<=20000000000"
 python3 -m tools.tracedump "$TRACE_SMOKE/sequential/server/trace.jsonl" \
   --format json > /dev/null
 python3 -m tools.tracedump "$TRACE_SMOKE/ep/server/trace.jsonl" \

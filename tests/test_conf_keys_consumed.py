@@ -62,6 +62,14 @@ CONSUMERS: dict[tuple[str, str], list[str]] = {
     ],
     ("algorithm_kwargs", "buffer_size"): ["util/buffered.py"],
     ("algorithm_kwargs", "staleness_alpha"): ["util/buffered.py"],
+    ("algorithm_kwargs", "client_chunk"): [
+        "parallel/spmd.py",
+        "util/calibration.py",
+    ],
+    ("algorithm_kwargs", "calibration_path"): [
+        "parallel/spmd.py",
+        "util/calibration.py",
+    ],
     ("fault_tolerance", "seed"): ["util/faults.py"],
     ("fault_tolerance", "straggler_rate"): ["util/faults.py"],
     ("fault_tolerance", "straggler_delay_seconds"): ["util/faults.py"],

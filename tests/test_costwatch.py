@@ -1,0 +1,455 @@
+"""costwatch (PR 13): the compiled cost/memory ledger must be pure
+metadata — ``program_cost`` events with the flat ledger schema riding
+the telemetry dispatch tail, ``cost_ledger()`` priced off the
+shardcheck inventory without executing anything, roofline math matching
+a host-f64 hand reference, ``tools/costview`` budget gates with
+tracedump-style exit codes, and the ``client_chunk: auto`` calibration
+path resolving bit-exact against the same constant set by hand (with a
+LOUD heuristic fallback on a cache miss)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import fed_avg_config
+from distributed_learning_simulator_tpu.training import _build_task, train
+from distributed_learning_simulator_tpu.util.calibration import (
+    save_calibration_entry,
+    session_calibration_key,
+)
+from distributed_learning_simulator_tpu.util.costwatch import (
+    LEDGER_FIELDS,
+    cost_summary,
+    hlo_op_histogram,
+    merge_ledgers,
+    normalize_cost,
+    roofline,
+)
+from tools.costview import attribute, check_budget, chip_tables, load_trace
+from tools.costview.__main__ import main as costview_main
+
+
+def _config(rounds, save_dir, telemetry=None, **overrides):
+    config = fed_avg_config(
+        executor="spmd",
+        worker_number=overrides.pop("worker_number", 4),
+        round=rounds,
+        batch_size=32,
+        epoch=1,
+        save_dir=save_dir,
+        dataset_kwargs={"train_size": 64, "val_size": 16, "test_size": 32},
+        **overrides,
+    )
+    if telemetry is not None:
+        config.telemetry = telemetry
+    config.load_config_and_process()
+    return config
+
+
+def _session(config):
+    from distributed_learning_simulator_tpu.parallel.spmd import (
+        SpmdFedAvgSession,
+    )
+
+    ctx = _build_task(config)
+    return SpmdFedAvgSession(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+
+
+def _trace_path(save_dir):
+    return os.path.join(save_dir, "server", "trace.jsonl")
+
+
+def _run_one_round(session, seed=0):
+    """The bench/autotune measurement seam: one round of the session's
+    own round program, host-fetched leaves returned for comparison."""
+    global_params = jax.device_put(
+        session.engine.init_params(session.config.seed), session._replicated
+    )
+    _, weights, rngs, sel_idx = session._prepare_round_inputs(
+        1, jax.random.PRNGKey(seed)
+    )
+    if sel_idx is not None:
+        global_params, metrics = session._round_fn(
+            global_params, weights, rngs, sel_idx
+        )
+    else:
+        global_params, metrics = session._round_fn(global_params, weights, rngs)
+    return [np.asarray(leaf) for leaf in jax.tree.leaves(global_params)]
+
+
+# ---------------------------------------------------------------- ledger
+def test_cost_summary_schema_on_compiled_program():
+    """AOT-compiled matmul prices through the full ledger schema with
+    positive flops/bytes, and normalize_cost survives every shape XLA
+    returns (dict, one-element list, junk)."""
+    fn = jax.jit(lambda a, b: (a @ b).sum())
+    arg = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    row = cost_summary(fn.lower(arg, arg).compile())
+    assert set(LEDGER_FIELDS) <= set(row)
+    assert row["flops"] > 0
+    assert row["bytes_accessed"] > 0
+    assert all(isinstance(row[field], float) for field in LEDGER_FIELDS)
+    # both wire shapes of cost_analysis() normalize identically
+    as_dict = normalize_cost({"flops": 8.0, "bytes accessed": 4.0})
+    as_list = normalize_cost([{"flops": 8.0, "bytes accessed": 4.0}])
+    assert as_dict == as_list == {"flops": 8.0, "bytes_accessed": 4.0}
+    assert normalize_cost(None) == {"flops": 0.0, "bytes_accessed": 0.0}
+    assert normalize_cost([]) == {"flops": 0.0, "bytes_accessed": 0.0}
+    # merge_ledgers sums field-wise and ignores extra keys
+    total = merge_ledgers([row, row])
+    assert total["flops"] == pytest.approx(2 * row["flops"])
+
+
+def test_hlo_op_histogram_names_op_families():
+    """The opcode histogram over real optimized HLO: rows carry
+    op/count/output_bytes, sorted by output bytes descending — the view
+    that names the top consumer behind a low MFU."""
+    fn = jax.jit(lambda a, b: jnp.tanh(a @ b))
+    arg = jax.ShapeDtypeStruct((32, 32), jnp.float32)
+    hist = hlo_op_histogram(fn.lower(arg, arg).compile().as_text())
+    assert hist, "histogram empty on real HLO"
+    for row in hist:
+        assert set(row) == {"op", "count", "output_bytes"}
+        assert row["count"] >= 1
+    byte_counts = [row["output_bytes"] for row in hist]
+    assert byte_counts == sorted(byte_counts, reverse=True)
+    assert hlo_op_histogram("", top=3) == []
+    assert len(hlo_op_histogram("\n".join([""] * 5) or "x", top=1)) <= 1
+
+
+def test_roofline_matches_host_reference():
+    """Roofline math vs an explicit host-f64 hand computation on a v5e
+    shape (hbm-bound), a compute-bound shape, and the no-tables case."""
+    peak, bw = 197e12, 0.82e12
+    flops, bytes_accessed, seconds = 4e12, 2e10, 0.05
+    out = roofline(flops, bytes_accessed, seconds, peak, bw)
+    intensity = flops / bytes_accessed  # 200.0
+    ridge = peak / bw  # ~240.2
+    attainable = min(peak, intensity * bw)  # 164e12, hbm roof
+    assert out["arithmetic_intensity"] == pytest.approx(intensity)
+    assert out["ridge_intensity"] == pytest.approx(ridge)
+    assert out["bound_by"] == "hbm"
+    assert out["roofline_flops_per_s"] == pytest.approx(attainable)
+    assert out["roofline_mfu"] == pytest.approx(attainable / peak)
+    assert out["achieved_flops_per_s"] == pytest.approx(flops / seconds)
+    assert out["achieved_mfu"] == pytest.approx(flops / seconds / peak)
+    assert out["fraction_of_roofline"] == pytest.approx(
+        (flops / seconds) / attainable
+    )
+    # compute-bound: intensity above the ridge caps at peak
+    out = roofline(1e15, 1e9, peak_flops=peak, hbm_bandwidth=bw)
+    assert out["bound_by"] == "compute"
+    assert out["roofline_flops_per_s"] == pytest.approx(peak)
+    assert out["roofline_mfu"] == pytest.approx(1.0)
+    # no chip tables: classification is honest, never a guess
+    out = roofline(1e12, 1e9)
+    assert out["bound_by"] == "unknown"
+    assert out["roofline_mfu"] == 0.0
+    assert "achieved_mfu" not in out
+
+
+def test_chip_tables_longest_prefix_and_unknown():
+    from tools.costview import TraceError
+
+    peak, bw = chip_tables("TPU v5 lite", count=4)
+    assert peak == pytest.approx(4 * 197e12)
+    assert bw == pytest.approx(4 * 0.82e12)
+    with pytest.raises(TraceError):
+        chip_tables("GPU H100")
+
+
+# --------------------------------------------------- trace round-trip
+def test_trace_roundtrip_cost_events_and_costview(tmp_session_dir):
+    """Telemetry-on run → program_cost events + dispatch_call spans in
+    the trace → costview attribution with the full budget surface; the
+    capture_cost/capture_hbm knobs gate the records off without touching
+    the trajectory (bit-exact params either way)."""
+    r_on = train(_config(rounds=2, save_dir="on", telemetry={"enabled": True}))
+    r_off = train(
+        _config(
+            rounds=2,
+            save_dir="nocost",
+            telemetry={
+                "enabled": True,
+                "capture_cost": False,
+                "capture_hbm": False,
+            },
+        )
+    )
+    # cost capture is observability only: trajectories identical
+    for rn in r_on["performance"]:
+        assert (
+            r_on["performance"][rn]["test_accuracy"]
+            == r_off["performance"][rn]["test_accuracy"]
+        ), rn
+
+    records = load_trace(_trace_path("on"))
+    costs = [
+        r for r in records if r.get("ev") == "event" and r["kind"] == "program_cost"
+    ]
+    calls = [
+        r for r in records if r.get("ev") == "span" and r["kind"] == "dispatch_call"
+    ]
+    assert costs, "no program_cost events captured"
+    assert calls, "no dispatch_call spans captured"
+    for row in costs:
+        assert set(LEDGER_FIELDS) <= set(row), row
+        assert row["program"]
+    assert {r["program"] for r in costs} <= {r["program"] for r in calls}
+    assert all(r["dur"] >= 0 for r in calls)
+
+    # the capture-off trace carries NO cost/hbm records but still counts
+    nocost = load_trace(_trace_path("nocost"))
+    assert not [r for r in nocost if r.get("kind") in ("program_cost", "hbm")]
+    assert [r for r in nocost if r.get("kind") == "dispatch_call"]
+
+    peak, bw = chip_tables("TPU v5e", count=1)
+    attribution = attribute(records, peak_flops=peak, hbm_bandwidth=bw)
+    budget = attribution["budget"]
+    for key in (
+        "programs_total",
+        "flops_total",
+        "bytes_accessed_total",
+        "temp_bytes",
+        "peak_hbm_bytes",
+        "rounds_total",
+        "round_seconds_total",
+        "device_seconds_total",
+        "host_gap_seconds_total",
+        "host_gap_fraction",
+    ):
+        assert key in budget, key
+    assert budget["programs_total"] >= 1
+    assert budget["flops_total"] > 0
+    assert budget["rounds_total"] == 2
+    assert budget["round_seconds_total"] >= budget["device_seconds_total"]
+    for row in attribution["programs"].values():
+        assert row["bound_by"] in ("compute", "hbm", "unknown")
+        assert "roofline_mfu" in row
+    # the budget gate surface accepts generous bounds, rejects tight ones
+    assert not check_budget(attribution, ["temp_bytes<=900000000000"])
+    violations = check_budget(attribution, ["flops_total<=1"])
+    assert violations and "flops_total" in violations[0]
+
+
+def test_session_cost_ledger_prices_shardcheck_inventory(tmp_session_dir):
+    """``session.cost_ledger()`` prices every program in the shardcheck
+    inventory via abstract AOT compiles — rows carry the ledger schema
+    with positive flops, and NOTHING dispatches (counters stay 0)."""
+    session = _session(_config(rounds=1, save_dir="ledger"))
+    ledger = session.cost_ledger()
+    assert ledger, "empty ledger on an SPMD session"
+    for name, row in ledger.items():
+        assert set(LEDGER_FIELDS) <= set(row), name
+    assert any(row["flops"] > 0 for row in ledger.values())
+    assert session.dispatch_count == 0
+    assert session.host_sync_count == 0
+
+
+# -------------------------------------------------------- costview CLI
+def _write_cost_trace(path, temp_bytes):
+    from distributed_learning_simulator_tpu.util.telemetry import TraceRecorder
+
+    rec = TraceRecorder(enabled=True, path=path, meta={"tool": "test"})
+    rec.event(
+        "program_cost",
+        program="train_round",
+        flops=1e9,
+        bytes_accessed=1e7,
+        argument_bytes=4e5,
+        output_bytes=2e5,
+        temp_bytes=temp_bytes,
+        generated_code_bytes=1e4,
+    )
+    rec.span_record("dispatch_call", 0.02, program="train_round")
+    rec.span_record("round", 0.05, round=1)
+    rec.event("hbm", round=1, bytes_in_use=5e8, peak_bytes_in_use=6e8)
+    rec.close()
+    return path
+
+
+def test_costview_cli_exit_codes(tmp_path, capsys):
+    """Exit-code contract mirrors tracedump: 0 clean, 1 on a violated
+    budget or a --diff cost regression, 2 on usage errors."""
+    trace = _write_cost_trace(str(tmp_path / "trace.jsonl"), temp_bytes=16400)
+    assert costview_main([trace, "--chip", "TPU v5e"]) == 0
+    out = capsys.readouterr().out
+    assert "train_round" in out
+    assert "peak_hbm" in out
+    assert costview_main([trace, "--assert-budget", "temp_bytes<=20000"]) == 0
+    assert costview_main([trace, "--assert-budget", "temp_bytes<=1"]) == 1
+    assert (
+        costview_main([trace, "--assert-budget", "peak_hbm_bytes<=100"]) == 1
+    )
+    # unknown budget key / unknown chip / unreadable trace: usage errors
+    assert costview_main([trace, "--assert-budget", "bogus_key<=1"]) == 2
+    assert costview_main([trace, "--chip", "GPU H100"]) == 2
+    assert costview_main([str(tmp_path / "missing.jsonl")]) == 2
+    # --diff: rising temp bytes is a regression (exit 1), shrinking is not
+    baseline = _write_cost_trace(str(tmp_path / "base.jsonl"), temp_bytes=99)
+    assert costview_main([trace, "--diff", baseline]) == 1
+    assert costview_main([baseline, "--diff", trace]) == 0
+    # json format round-trips with the budget surface attached
+    assert costview_main([trace, "--format", "json"]) == 0
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert payload["budget"]["temp_bytes"] == 16400
+    assert payload["budget"]["peak_hbm_bytes"] == 6e8
+    assert payload["budget_failures"] == []
+
+
+# ------------------------------------------------------------- autotune
+def test_pick_winner_argmin_with_tie_toward_smaller_chunk():
+    from tools.autotune import pick_winner
+
+    assert pick_winner({1: 0.5, 2: 0.5, 4: 0.4}) == 4
+    assert pick_winner({4: 0.25, 2: 0.25}) == 2  # tie -> smaller chunk
+    assert pick_winner({8: 0.1}) == 8
+
+
+def test_autotune_sweep_deterministic_with_injected_timer(
+    tmp_session_dir, tmp_path
+):
+    """Same seed + same (injected, wall-clock-free) timer → the SAME
+    entry twice, written under the canonical calibration key."""
+    from tools.autotune import run_sweep
+
+    def factory_for(tag):
+        def config_factory(chunk):
+            return _config(
+                rounds=1,
+                save_dir=f"at_{tag}_{chunk}",
+                algorithm_kwargs={"client_chunk": chunk},
+            )
+
+        return config_factory
+
+    def fake_leg(session, seed, rounds, warmup):
+        # deterministic function of the leg's chunk; also pins that the
+        # factory's chunk actually reached the session
+        assert session.client_chunk in (1, 2)
+        return 0.3 / float(session.client_chunk)
+
+    results = [
+        run_sweep(
+            factory_for(tag),
+            candidates=[1, 2],
+            rounds=2,
+            warmup=1,
+            seed=0,
+            output=str(tmp_path / "calibration.json"),
+            time_leg=fake_leg,
+        )
+        for tag in ("a", "b")
+    ]
+    assert results[0]["key"] == results[1]["key"]
+    assert results[0]["entry"] == results[1]["entry"]
+    assert results[0]["entry"]["client_chunk"] == 2
+    assert results[0]["entry"]["legs"] == {"1": 0.3, "2": 0.15}
+    with open(tmp_path / "calibration.json", encoding="utf8") as f:
+        blob = json.load(f)
+    assert blob["entries"][results[0]["key"]]["client_chunk"] == 2
+
+
+def test_client_chunk_auto_bit_exact_vs_hand_constant(
+    tmp_session_dir, tmp_path
+):
+    """The acceptance pin: ``client_chunk: auto`` resolving to N from
+    the calibration cache is BIT-EXACT vs ``client_chunk: N`` set by
+    hand — same resolved chunk, identical round outputs."""
+    hand = _session(
+        _config(
+            rounds=1, save_dir="hand", algorithm_kwargs={"client_chunk": 2}
+        )
+    )
+    cache = str(tmp_path / "calibration.json")
+    save_calibration_entry(
+        session_calibration_key(hand), {"client_chunk": 2}, cache
+    )
+    auto = _session(
+        _config(
+            rounds=1,
+            save_dir="auto",
+            algorithm_kwargs={
+                "client_chunk": "auto",
+                "calibration_path": cache,
+            },
+        )
+    )
+    assert auto.client_chunk == hand.client_chunk == 2
+    for a, b in zip(_run_one_round(hand), _run_one_round(auto)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_client_chunk_auto_miss_falls_back_to_default(
+    tmp_session_dir, tmp_path
+):
+    """A cache miss resolves to 0 — the exact hand-set-default heuristic
+    path, so ``auto`` without calibration behaves like an unset knob."""
+    session = _session(
+        _config(
+            rounds=1,
+            save_dir="miss",
+            algorithm_kwargs={
+                "client_chunk": "auto",
+                "calibration_path": str(tmp_path / "nope.json"),
+            },
+        )
+    )
+    assert session._client_chunk_auto is True
+    assert session.client_chunk == 0
+    default = _session(_config(rounds=1, save_dir="unset"))
+    assert session.client_chunk == default.client_chunk
+
+
+@pytest.mark.slow
+def test_autotune_calibration_end_to_end(tmp_session_dir, tmp_path):
+    """Real (wall-clock) sweep on the tiny shape: writes a winner entry
+    an ``auto`` session then resolves — the zero→calibrated loop."""
+    from tools.autotune import run_sweep
+
+    def config_factory(chunk):
+        return _config(
+            rounds=1,
+            save_dir=f"e2e_{chunk}",
+            algorithm_kwargs={"client_chunk": chunk},
+        )
+
+    cache = str(tmp_path / "calibration.json")
+    result = run_sweep(
+        config_factory,
+        candidates=[1, 2],
+        rounds=1,
+        warmup=1,
+        seed=0,
+        output=cache,
+        trace_path=str(tmp_path / "sweep_trace.jsonl"),
+    )
+    winner = result["entry"]["client_chunk"]
+    assert winner in (1, 2)
+    spans = [
+        r
+        for r in load_trace(str(tmp_path / "sweep_trace.jsonl"))
+        if r.get("kind") == "autotune_leg"
+    ]
+    assert len(spans) == 2
+    session = _session(
+        _config(
+            rounds=1,
+            save_dir="e2e_auto",
+            algorithm_kwargs={
+                "client_chunk": "auto",
+                "calibration_path": cache,
+            },
+        )
+    )
+    assert session.client_chunk == winner

@@ -1,0 +1,176 @@
+"""autotune: one-shot seeded calibration sweep over ``client_chunk``.
+
+``client_chunk`` sizes the per-device client scan's minibatch of slots —
+too small leaves the MXU idle between chunk boundaries, too large blows
+the temp-buffer watermark the costwatch ledger now gates.  The right
+value is a property of (session class, model, mesh, slot count, batch),
+so it belongs in a measured cache, not a YAML constant.
+
+This tool runs the sweep::
+
+    python -m tools.autotune --model LeNet5 --dataset MNIST \
+        --workers 8 --selected 4 --batch 16 --candidates 1,2,4 \
+        --rounds 2 --output calibration.json
+
+Per candidate ("leg") it builds a FRESH session with that chunk, runs
+the session's own round program (``_prepare_round_inputs`` →
+``_round_fn``, the exact bench measurement seam — no eval, no
+checkpoints), times ``rounds`` rounds after ``warmup`` compile rounds,
+and records the leg as an ``autotune_leg`` trace span.  The winner
+(min mean seconds; ties break toward the SMALLER chunk — less temp
+memory for equal speed) is merged into ``calibration.json`` under the
+canonical :func:`~distributed_learning_simulator_tpu.util.calibration.
+calibration_key`, which sessions consult when
+``algorithm_kwargs.client_chunk: auto``.
+
+Determinism: the sweep seeds selection/init from ``--seed``, entries
+carry no timestamps, and the winner rule is a pure argmin over the leg
+table — so a re-run on identical hardware rewrites an identical entry
+(``tests/test_costwatch.py`` pins this with an injected timer).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Callable, Iterable
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO not in sys.path:  # `python -m tools.autotune` from anywhere
+    sys.path.insert(0, _REPO)
+
+
+def default_candidates(s_pad: int) -> list[int]:
+    """Power-of-two chunks up to the padded slot count, plus the full
+    count itself (the no-chunking leg).  ``chunk_size`` divisor-clamps
+    at dispatch, so off-divisor candidates still run — they just
+    collapse onto a nearby divisor."""
+    out = []
+    c = 1
+    while c < s_pad:
+        out.append(c)
+        c *= 2
+    out.append(s_pad)
+    return out
+
+
+def _build_session(config):
+    from distributed_learning_simulator_tpu.training import (
+        _build_task,
+        resolve_spmd_session_class,
+    )
+
+    cls = resolve_spmd_session_class(config)
+    if cls is None:
+        raise ValueError(
+            "autotune requires an SPMD config (client_chunk is a "
+            "device-scan knob; the threaded executor has no scan)"
+        )
+    ctx = _build_task(config)
+    return cls(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+
+
+def _time_leg(session, seed: int, rounds: int, warmup: int) -> float:
+    """Mean seconds/round of the session's own round program (the bench
+    ``_measure_session`` seam: warmup compiles, host-fetch hard sync)."""
+    import time
+
+    import jax
+    import numpy as np
+
+    global_params = jax.device_put(
+        session.engine.init_params(session.config.seed),
+        session._replicated,
+    )
+    _, weights, rngs, sel_idx = session._prepare_round_inputs(
+        1, jax.random.PRNGKey(seed)
+    )
+
+    def run_round(gp):
+        if sel_idx is not None:
+            return session._round_fn(gp, weights, rngs, sel_idx)
+        return session._round_fn(gp, weights, rngs)
+
+    for _ in range(max(1, warmup)):
+        global_params, metrics = run_round(global_params)
+    float(np.asarray(jax.tree.leaves(metrics)[0]))
+    start = time.monotonic()
+    for _ in range(rounds):
+        global_params, metrics = run_round(global_params)
+    float(np.asarray(jax.tree.leaves(metrics)[0]))
+    return (time.monotonic() - start) / rounds
+
+
+def pick_winner(legs: dict[int, float]) -> int:
+    """Pure argmin with ties toward the smaller chunk (determinism +
+    less temp memory for equal speed)."""
+    winner, best = 0, float("inf")
+    for chunk in sorted(legs):
+        if legs[chunk] < best:
+            winner, best = chunk, legs[chunk]
+    return winner
+
+
+def run_sweep(
+    config_factory: Callable[[Any], Any],
+    candidates: Iterable[int] | None = None,
+    rounds: int = 2,
+    warmup: int = 1,
+    seed: int = 0,
+    output: str | None = None,
+    trace_path: str | None = None,
+    time_leg: Callable[..., float] | None = None,
+) -> dict[str, Any]:
+    """Sweep ``client_chunk`` candidates and (optionally) persist the
+    winner.  ``config_factory(chunk)`` must return a FRESH config with
+    that chunk in ``algorithm_kwargs``; ``time_leg`` is injectable so
+    the determinism test can pin the winner rule without wall-clock
+    noise.  Returns ``{"key", "entry", "path"}``."""
+    import jax
+
+    from distributed_learning_simulator_tpu.util.calibration import (
+        save_calibration_entry,
+        session_calibration_key,
+    )
+    from distributed_learning_simulator_tpu.util.telemetry import TraceRecorder
+
+    time_leg = time_leg or _time_leg
+    recorder = TraceRecorder(
+        enabled=bool(trace_path), path=trace_path,
+        meta={"tool": "autotune", "seed": seed},
+    )
+    probe = _build_session(config_factory(1))
+    key = session_calibration_key(probe)
+    if candidates is None:
+        candidates = default_candidates(probe.s_pad)
+    del probe
+    legs: dict[int, float] = {}
+    for chunk in sorted(set(int(c) for c in candidates)):
+        session = _build_session(config_factory(chunk))
+        with recorder.span("autotune_leg", chunk=chunk, key=key):
+            seconds = time_leg(session, seed=seed, rounds=rounds, warmup=warmup)
+        legs[chunk] = round(float(seconds), 6)
+        del session
+    winner = pick_winner(legs)
+    recorder.event("autotune_winner", key=key, client_chunk=winner)
+    recorder.close()
+    entry = {
+        "client_chunk": winner,
+        "legs": {str(chunk): legs[chunk] for chunk in sorted(legs)},
+        "seed": int(seed),
+        "rounds": int(rounds),
+        "warmup": int(warmup),
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "device_count": len(jax.devices()),
+    }
+    path = None
+    if output is not None:
+        path = save_calibration_entry(key, entry, output)
+    return {"key": key, "entry": entry, "path": path}

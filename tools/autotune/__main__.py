@@ -1,0 +1,110 @@
+"""CLI: ``python -m tools.autotune --model M --dataset D --workers N
+[--selected K] [--batch B] [--samples-per-client S] [--candidates 1,2,4]
+[--rounds R] [--warmup W] [--seed S] [--algorithm fed_avg]
+[--output calibration.json] [--trace PATH]``
+
+Builds the bench config shape (``bench.make_config``) per candidate and
+runs the seeded sweep; prints the winner entry as JSON.  Exit 0 on a
+written entry, 2 on usage errors."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.autotune",
+        description="seeded client_chunk calibration sweep"
+        " (docs/observability.md)",
+    )
+    parser.add_argument("--model", required=True, help="e.g. LeNet5, bert_small")
+    parser.add_argument("--dataset", default="MNIST", help="e.g. MNIST, AGNews")
+    parser.add_argument("--workers", type=int, required=True)
+    parser.add_argument(
+        "--selected", type=int, default=0,
+        help="random_client_number (0 = full participation)",
+    )
+    parser.add_argument("--batch", type=int, default=32)
+    parser.add_argument(
+        "--samples-per-client", type=int, default=0,
+        help="train samples per client (default: one batch)",
+    )
+    parser.add_argument("--max-len", type=int, default=0, help="text seq len")
+    parser.add_argument("--algorithm", default="fed_avg")
+    parser.add_argument(
+        "--candidates", default="",
+        help="comma-separated chunks (default: powers of two up to s_pad)",
+    )
+    parser.add_argument("--rounds", type=int, default=2)
+    parser.add_argument("--warmup", type=int, default=1)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--output", default=None,
+        help="calibration.json to merge the winner into"
+        " (default: repo-root calibration.json)",
+    )
+    parser.add_argument(
+        "--trace", default=None, help="write the sweep's trace spans here"
+    )
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    from bench import make_config
+
+    from distributed_learning_simulator_tpu.util.calibration import (
+        DEFAULT_CALIBRATION_PATH,
+    )
+    from . import run_sweep
+
+    samples = args.samples_per_client or args.batch
+    dataset_extra = {}
+    if args.max_len:
+        dataset_extra["max_len"] = args.max_len
+
+    def config_factory(chunk):
+        algorithm_kwargs = {"client_chunk": chunk}
+        if args.selected:
+            algorithm_kwargs["random_client_number"] = args.selected
+        return make_config(
+            "spmd",
+            args.workers,
+            args.workers * samples,
+            model_name=args.model,
+            batch_size=args.batch,
+            tag=f"autotune_{args.model}_{chunk}",
+            dataset_name=args.dataset,
+            dataset_extra=dataset_extra,
+            distributed_algorithm=args.algorithm,
+            algorithm_kwargs=algorithm_kwargs,
+            seed=args.seed,
+        )
+
+    candidates = (
+        [int(c) for c in args.candidates.split(",") if c.strip()]
+        if args.candidates
+        else None
+    )
+    try:
+        result = run_sweep(
+            config_factory,
+            candidates=candidates,
+            rounds=args.rounds,
+            warmup=args.warmup,
+            seed=args.seed,
+            output=args.output or DEFAULT_CALIBRATION_PATH,
+            trace_path=args.trace,
+        )
+    except (ValueError, OSError) as exc:
+        print(f"autotune: {exc}", file=sys.stderr)
+        return 2
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,291 @@
+"""costview: roofline + wall-time attribution over costwatch traces.
+
+``tools/tracedump`` answers "how many dispatches/retraces did the run
+make"; costview answers "what does each program COST and where does the
+round's wall time go".  It reads the same roundtrace JSONL, but derives
+from the PR 13 costwatch records:
+
+* ``program_cost`` events — the flat ledger schema per compiled program
+  (flops / bytes accessed / argument / output / temp /
+  generated-code bytes);
+* ``dispatch_call`` spans — the host-blocking wall of every jitted
+  call, keyed by program;
+* ``round`` spans — the per-round wall the host gap is measured
+  against;
+* ``hbm`` events — ``device.memory_stats()`` live/peak watermarks.
+
+::
+
+    python -m tools.costview <trace.jsonl>                    # text table
+    python -m tools.costview <trace> --chip "TPU v5e" --chip-count 4
+    python -m tools.costview <trace> --format json
+    python -m tools.costview <trace> --diff <baseline.jsonl>
+    python -m tools.costview <trace> \
+        --assert-budget "temp_bytes<=2000000000" \
+        --assert-budget "peak_hbm_bytes<=17000000000"          # CI gate
+
+Exit status mirrors tracedump: 0 clean; 1 on a failed budget assertion
+or a ``--diff`` cost regression (max temp bytes or peak HBM rose); 2 on
+usage errors.
+
+Roofline inputs: pass ``--peak-flops``/``--hbm-bandwidth`` explicitly,
+or ``--chip <device kind>`` (+ ``--chip-count``) to use the costwatch
+tables — chip detection is never implicit, because traces are routinely
+inspected off the machine that produced them.  Without peaks the table
+still reports costs and wall decomposition; bound-by reads ``unknown``.
+
+Honesty notes baked into the numbers: XLA's ``cost_analysis`` prices a
+``scan`` body ONCE, not × trip count, so for ``horizon[h=...]``-style
+programs ``achieved_flops_per_s`` (ledger flops / measured wall) is a
+LOWER bound; and ``dispatch_call`` spans measure the host-blocking
+portion of the call — on an async backend the rest of the device time
+is only observable at the round's one sync point, which is exactly the
+``host_gap`` column.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO not in sys.path:  # `python -m tools.costview` from anywhere
+    sys.path.insert(0, _REPO)
+
+from distributed_learning_simulator_tpu.util.costwatch import (  # noqa: E402
+    BF16_PEAK,
+    HBM_BANDWIDTH,
+    LEDGER_FIELDS,
+    merge_ledgers,
+    roofline,
+)
+from tools.tracedump import (  # noqa: E402
+    TraceError,
+    check_budget,
+    load_trace,
+)
+
+#: budget keys whose INCREASE vs a ``--diff`` baseline is a regression
+COST_REGRESSION_KEYS = ("temp_bytes", "peak_hbm_bytes")
+
+
+def chip_tables(chip: str, count: int = 1) -> tuple[float, float]:
+    """(peak FLOP/s, HBM bytes/s) for ``count`` devices of ``chip`` from
+    the costwatch tables (longest-prefix match, like the runtime)."""
+    peak = bw = 0.0
+    for name in sorted(BF16_PEAK, key=len, reverse=True):
+        if chip.startswith(name):
+            peak = BF16_PEAK[name] * count
+            bw = HBM_BANDWIDTH.get(name, 0.0) * count
+            break
+    if peak == 0.0:
+        raise TraceError(
+            f"unknown chip {chip!r} — known: {sorted(BF16_PEAK)}"
+        )
+    return peak, bw
+
+
+def attribute(
+    records: list[dict],
+    peak_flops: float = 0.0,
+    hbm_bandwidth: float = 0.0,
+) -> dict[str, Any]:
+    """The attribution structure every costview consumer reads: per
+    program (ledger ∪ wall), the round wall decomposition, the HBM
+    watermarks, and the flat ``budget`` gate surface."""
+    costs: dict[str, dict[str, float]] = {}
+    calls: dict[str, dict[str, float]] = {}
+    round_seconds = 0.0
+    rounds_total = 0
+    hbm_peak = 0.0
+    hbm_live = 0.0
+    hbm_samples = 0
+    for record in records:
+        ev = record.get("ev")
+        kind = record.get("kind", "")
+        if ev == "event" and kind == "program_cost":
+            program = str(record.get("program", "?"))
+            # last capture wins: a retrace's re-priced program replaces
+            # the stale row rather than double-counting it
+            costs[program] = {
+                field: float(record.get(field, 0.0) or 0.0)
+                for field in LEDGER_FIELDS
+            }
+        elif ev == "event" and kind == "hbm":
+            hbm_samples += 1
+            hbm_live = float(record.get("bytes_in_use", 0) or 0)
+            hbm_peak = max(
+                hbm_peak, float(record.get("peak_bytes_in_use", 0) or 0)
+            )
+        elif ev == "span" and kind == "dispatch_call":
+            program = str(record.get("program", "?"))
+            row = calls.setdefault(program, {"calls": 0, "device_seconds": 0.0})
+            row["calls"] += 1
+            row["device_seconds"] += float(record.get("dur", 0.0) or 0.0)
+        elif ev == "span" and kind == "round":
+            rounds_total += 1
+            round_seconds += float(record.get("dur", 0.0) or 0.0)
+
+    programs: dict[str, dict[str, Any]] = {}
+    for name in sorted(set(costs) | set(calls)):
+        row: dict[str, Any] = dict.fromkeys(LEDGER_FIELDS, 0.0)
+        row.update(costs.get(name, {}))
+        wall = calls.get(name, {"calls": 0, "device_seconds": 0.0})
+        row["calls"] = int(wall["calls"])
+        row["device_seconds"] = round(wall["device_seconds"], 6)
+        mean_call = (
+            wall["device_seconds"] / wall["calls"] if wall["calls"] else 0.0
+        )
+        row["mean_call_seconds"] = round(mean_call, 6)
+        row.update(
+            roofline(
+                row["flops"],
+                row["bytes_accessed"],
+                seconds=mean_call,
+                peak_flops=peak_flops,
+                hbm_bandwidth=hbm_bandwidth,
+            )
+        )
+        programs[name] = row
+
+    device_seconds = sum(r["device_seconds"] for r in programs.values())
+    host_gap = max(0.0, round_seconds - device_seconds)
+    totals = merge_ledgers(programs.values())
+
+    def _max(field: str) -> float:
+        return max((r[field] for r in programs.values()), default=0.0)
+
+    budget = {
+        "programs_total": len(programs),
+        "flops_total": totals["flops"],
+        "bytes_accessed_total": totals["bytes_accessed"],
+        "temp_bytes": _max("temp_bytes"),
+        "temp_bytes_total": totals["temp_bytes"],
+        "argument_bytes": _max("argument_bytes"),
+        "output_bytes": _max("output_bytes"),
+        "generated_code_bytes": _max("generated_code_bytes"),
+        "peak_hbm_bytes": hbm_peak,
+        "live_hbm_bytes": hbm_live,
+        "hbm_samples": hbm_samples,
+        "rounds_total": rounds_total,
+        "round_seconds_total": round(round_seconds, 6),
+        "device_seconds_total": round(device_seconds, 6),
+        "host_gap_seconds_total": round(host_gap, 6),
+        "host_gap_fraction": round(
+            host_gap / round_seconds if round_seconds > 0 else 0.0, 6
+        ),
+    }
+    return {
+        "peak_flops": peak_flops,
+        "hbm_bandwidth": hbm_bandwidth,
+        "programs": programs,
+        "totals": totals,
+        "budget": budget,
+        # tracedump.check_budget's event fallback surface (empty: every
+        # costview gate key lives in `budget`)
+        "events": {},
+    }
+
+
+def diff_attributions(candidate: dict, baseline: dict) -> dict[str, Any]:
+    """Budget deltas + the cost regressions (max temp bytes or peak HBM
+    watermark INCREASED vs the baseline trace)."""
+    deltas: dict[str, dict] = {}
+    regressions: list[str] = []
+    keys = sorted(set(candidate["budget"]) | set(baseline["budget"]))
+    for key in keys:
+        new = float(candidate["budget"].get(key, 0.0))
+        old = float(baseline["budget"].get(key, 0.0))
+        deltas[key] = {
+            "candidate": new,
+            "baseline": old,
+            "delta": round(new - old, 6),
+        }
+        if key in COST_REGRESSION_KEYS and new > old + 1e-9:
+            regressions.append(
+                f"cost regression: {key} rose {old:g} -> {new:g} "
+                f"(+{new - old:g})"
+            )
+    return {"deltas": deltas, "regressions": regressions}
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit, scale in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if abs(n) >= scale:
+            return f"{n / scale:.2f}{unit}"
+    return f"{n:.0f}B"
+
+
+def _fmt_flops(n: float) -> str:
+    for unit, scale in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("K", 1e3)):
+        if abs(n) >= scale:
+            return f"{n / scale:.2f}{unit}"
+    return f"{n:.0f}"
+
+
+def format_text(attribution: dict) -> str:
+    lines = []
+    if attribution["peak_flops"]:
+        lines.append(
+            f"roofline: peak={_fmt_flops(attribution['peak_flops'])}FLOP/s "
+            f"hbm={_fmt_bytes(attribution['hbm_bandwidth'])}/s "
+            f"ridge={attribution['peak_flops'] / attribution['hbm_bandwidth']:.1f}"
+            if attribution["hbm_bandwidth"]
+            else f"roofline: peak={_fmt_flops(attribution['peak_flops'])}FLOP/s"
+        )
+    programs = attribution["programs"]
+    if programs:
+        lines.append("programs:")
+        header = (
+            f"  {'program':<26}{'flops':>9}{'bytes':>10}{'temp':>10}"
+            f"{'args':>10}{'AI':>7}{'bound':>9}{'calls':>6}"
+            f"{'wall_s':>9}{'mfu':>7}{'roof':>7}"
+        )
+        lines.append(header)
+        for name, row in sorted(
+            programs.items(), key=lambda kv: -kv[1]["device_seconds"]
+        ):
+            lines.append(
+                f"  {name:<26}{_fmt_flops(row['flops']):>9}"
+                f"{_fmt_bytes(row['bytes_accessed']):>10}"
+                f"{_fmt_bytes(row['temp_bytes']):>10}"
+                f"{_fmt_bytes(row['argument_bytes']):>10}"
+                f"{row['arithmetic_intensity']:>7.1f}"
+                f"{row['bound_by']:>9}"
+                f"{row['calls']:>6}"
+                f"{row['device_seconds']:>9.3f}"
+                f"{row.get('achieved_mfu', 0.0):>7.3f}"
+                f"{row['roofline_mfu']:>7.3f}"
+            )
+    budget = attribution["budget"]
+    lines.append(
+        "wall: "
+        f"rounds={budget['rounds_total']} "
+        f"round_s={budget['round_seconds_total']:g} "
+        f"device_s={budget['device_seconds_total']:g} "
+        f"host_gap_s={budget['host_gap_seconds_total']:g} "
+        f"({budget['host_gap_fraction'] * 100:.1f}% host)"
+    )
+    lines.append(
+        "memory: "
+        f"max_temp={_fmt_bytes(budget['temp_bytes'])} "
+        f"max_args={_fmt_bytes(budget['argument_bytes'])} "
+        f"peak_hbm={_fmt_bytes(budget['peak_hbm_bytes'])} "
+        f"live_hbm={_fmt_bytes(budget['live_hbm_bytes'])} "
+        f"(hbm_samples={budget['hbm_samples']})"
+    )
+    return "\n".join(lines)
+
+
+__all__ = [
+    "COST_REGRESSION_KEYS",
+    "TraceError",
+    "attribute",
+    "check_budget",
+    "chip_tables",
+    "diff_attributions",
+    "format_text",
+    "load_trace",
+]

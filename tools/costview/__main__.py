@@ -1,0 +1,120 @@
+"""CLI: ``python -m tools.costview <trace.jsonl> [--chip KIND]
+[--chip-count N] [--peak-flops F] [--hbm-bandwidth B]
+[--diff baseline] [--format text|json] [--assert-budget EXPR]...``
+
+Exit status: 0 clean; 1 on a failed budget assertion or a diff cost
+regression; 2 on usage errors (see ``tools/costview/__init__.py``)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import (
+    TraceError,
+    attribute,
+    check_budget,
+    chip_tables,
+    diff_attributions,
+    format_text,
+    load_trace,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.costview",
+        description="roofline + wall-time attribution over costwatch"
+        " traces (docs/observability.md)",
+    )
+    parser.add_argument("trace", help="roundtrace JSONL file")
+    parser.add_argument(
+        "--chip",
+        help="device kind for the roofline tables, e.g. 'TPU v5e'"
+        " (explicit — never auto-detected)",
+    )
+    parser.add_argument(
+        "--chip-count", type=int, default=1, help="devices of --chip"
+    )
+    parser.add_argument(
+        "--peak-flops",
+        type=float,
+        default=0.0,
+        help="aggregate peak FLOP/s (overrides --chip)",
+    )
+    parser.add_argument(
+        "--hbm-bandwidth",
+        type=float,
+        default=0.0,
+        help="aggregate HBM bytes/s (overrides --chip)",
+    )
+    parser.add_argument(
+        "--diff",
+        metavar="BASELINE",
+        help="second trace to diff against; cost regressions"
+        " (max temp bytes / peak HBM watermark increased) exit 1",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text"
+    )
+    parser.add_argument(
+        "--assert-budget",
+        action="append",
+        default=[],
+        metavar="EXPR",
+        help="budget expression like 'temp_bytes<=2000000000'"
+        " (repeatable; any violation exits 1)",
+    )
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        peak, bandwidth = args.peak_flops, args.hbm_bandwidth
+        if args.chip and not (peak and bandwidth):
+            chip_peak, chip_bw = chip_tables(args.chip, args.chip_count)
+            peak = peak or chip_peak
+            bandwidth = bandwidth or chip_bw
+        attribution = attribute(
+            load_trace(args.trace), peak_flops=peak, hbm_bandwidth=bandwidth
+        )
+        failures = check_budget(attribution, args.assert_budget)
+        diff = None
+        if args.diff:
+            diff = diff_attributions(
+                attribution,
+                attribute(
+                    load_trace(args.diff),
+                    peak_flops=peak,
+                    hbm_bandwidth=bandwidth,
+                ),
+            )
+            failures.extend(diff["regressions"])
+    except TraceError as exc:
+        print(f"costview: {exc}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        payload = dict(attribution, budget_failures=failures)
+        payload.pop("events", None)
+        if diff is not None:
+            payload["diff"] = diff
+        print(json.dumps(payload))
+    else:
+        print(format_text(attribution))
+        if diff is not None:
+            print("diff vs baseline:")
+            for key, row in diff["deltas"].items():
+                if row["delta"]:
+                    print(
+                        f"  {key}: {row['baseline']:g} -> "
+                        f"{row['candidate']:g} ({row['delta']:+g})"
+                    )
+        for failure in failures:
+            print(failure, file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
