@@ -151,6 +151,16 @@ def _measure_session(
         stats_out["wasted_compute_fraction"] = round(
             session.wasted_compute_fraction, 4
         )
+        # which AMP path the round programs take: bf16 params carried
+        # through the client scan ("resident", the default under
+        # use_amp), the legacy cast-around-every-kernel path
+        # ("per_kernel", amp_resident: false), or plain f32
+        stats_out["amp_path"] = (
+            ("resident" if getattr(session, "_amp_resident", False)
+             else "per_kernel")
+            if config.use_amp
+            else "f32"
+        )
     flops_per_round = session.round_flops(global_params)
 
     def run_round(gp):
@@ -196,8 +206,15 @@ def _measure_session(
                 "temporaries": round(row["temp_bytes"] / 2**30, 3),
             }
             memory_out["program_cost"] = row
+            # convert-family output bytes of the compiled round program
+            # (costwatch extra key; absent when the backend can't render
+            # HLO text → -1, the -1/absent-never contract)
+            memory_out["convert_bytes_per_round"] = float(
+                row.get("convert_bytes", -1.0)
+            )
         except Exception as exc:
             memory_out["program_hbm_gb"] = {"error": str(exc)[:120]}
+            memory_out["convert_bytes_per_round"] = -1.0
     return rounds_per_sec, mfu
 
 
@@ -1338,6 +1355,16 @@ def main() -> None:
                 },
                 "long_context": lc,
                 "large_scale": large_scale,
+                # which AMP path the flagship round program took
+                # ("resident" is the static default under use_amp; a
+                # failed large_scale leg reports the configured path) +
+                # its compiled convert-family bytes (-1 when the leg
+                # failed or the backend hid HLO text — the -1/absent-
+                # never contract)
+                "amp_path": large_scale.get("amp_path", "resident"),
+                "convert_bytes_per_round": large_scale.get(
+                    "convert_bytes_per_round", -1.0
+                ),
                 # selection-aware gather: which round path partial-
                 # participation configs take by default, the dense-vs-
                 # gather A/B, and the default path's wasted compute

@@ -52,6 +52,18 @@ class ComputeEngine:
         # trade recompute for activation memory — the standard TPU lever
         # when HBM, not FLOPs, binds (extra_hyper_parameters: {remat: true})
         self.use_remat = bool(hyper_parameter.extra.get("remat", False))
+        # named checkpoint policy (extra_hyper_parameters:
+        # {remat_policy: dots_saveable}): resolved against
+        # jax.checkpoint_policies, so `dots_saveable` keeps matmul
+        # outputs resident (recompute only the cheap elementwise tail)
+        # while `nothing_saveable` is the maximal-recompute bound.
+        # Setting a policy implies remat; the bare `remat: true` path
+        # (policy-less jax.checkpoint) is untouched and bit-exact.
+        self.remat_policy = self._resolve_remat_policy(
+            hyper_parameter.extra.get("remat_policy", "")
+        )
+        if self.remat_policy is not None:
+            self.use_remat = True
         # opt-in buffer donation for the jitted entry points
         # (extra_hyper_parameters: {donate_buffers: true}): XLA reuses the
         # incoming params/opt_state buffers for the outputs, halving the
@@ -62,6 +74,27 @@ class ComputeEngine:
         # loops) may turn it on.  Flip before first use of the cached
         # entry points.
         self.donate_buffers = bool(hyper_parameter.extra.get("donate_buffers", False))
+
+    @staticmethod
+    def _resolve_remat_policy(name):
+        """``remat_policy`` name → the ``jax.checkpoint_policies``
+        member, or None when unset.  Unknown names fail loudly with the
+        valid vocabulary — a silently-ignored policy would report the
+        OLD temp_bytes as a win."""
+        if not name:
+            return None
+        policies = jax.checkpoint_policies
+        policy = getattr(policies, str(name), None)
+        if policy is None or not callable(policy):
+            valid = sorted(
+                p for p in dir(policies)
+                if not p.startswith("_") and callable(getattr(policies, p))
+            )
+            raise ValueError(
+                f"unknown remat_policy {name!r}; valid jax.checkpoint_policies"
+                f" names: {valid}"
+            )
+        return policy
 
     # ---- pure functions (also used by the SPMD executor under vmap/shard_map)
 
@@ -81,7 +114,10 @@ class ComputeEngine:
             )
 
         if self.use_remat:
-            loss_call = jax.checkpoint(loss_call)
+            if self.remat_policy is not None:
+                loss_call = jax.checkpoint(loss_call, policy=self.remat_policy)
+            else:
+                loss_call = jax.checkpoint(loss_call)
         return jax.value_and_grad(loss_call, has_aux=True)(params, batch, rng)
 
     def train_step_fn(self, params, opt_state, batch, rng):

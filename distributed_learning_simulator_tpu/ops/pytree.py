@@ -194,6 +194,31 @@ def _matvec_f32(mat: jax.Array, weights: jax.Array) -> jax.Array:
     )
 
 
+def flat_stack_weighted_sum(
+    stacked: Mapping[str, jax.Array], weights: jax.Array
+) -> jax.Array:
+    """``w @ [K, D]`` over a LEADING-AXIS-STACKED params tree (the shape a
+    vmapped client chunk returns): sorted keys, each ``[K, *shape]`` leaf
+    reshaped to ``[K, prod(shape)]`` float32 rows, one HIGHEST-precision
+    matvec (:func:`_matvec_f32` — the fused Pallas accumulator on TPU).
+
+    This is the bf16-residency aggregation epilogue: the ``[K]`` weight
+    row contracts against ONE ``[K, D]`` matrix instead of broadcasting
+    across every param-shaped tensor, and the single f32 convert rides
+    the matvec input instead of per-leaf multiply/accumulate
+    temporaries.  Returns the ``[D]`` float32 ParamVec (layout =
+    ``ParamVecLayout.of`` of one row; split back via ``layout.split``)."""
+    k = weights.shape[0]
+    mat = jnp.concatenate(
+        [
+            jnp.reshape(stacked[key], (k, -1)).astype(jnp.float32)
+            for key in sorted(stacked)
+        ],
+        axis=1,
+    )
+    return _matvec_f32(mat, weights)
+
+
 @functools.partial(jax.jit, static_argnums=(2,))
 def flat_weighted_params(
     param_dicts: tuple, weights: jax.Array, layout: ParamVecLayout

@@ -38,6 +38,7 @@ from ..engine.engine import (
     summarize_metrics,
 )
 from ..ml_type import MachineLearningPhase as Phase
+from ..ops.pytree import ParamVecLayout, flat_stack_weighted_sum, tree_cast
 from ..util.checkpoint import atomic_json_dump
 from ..utils.logging import get_logger
 from .mesh import client_slots, make_mesh, put_sharded
@@ -359,6 +360,7 @@ def scan_weighted_clients(
     max_update_norm: float = 0.0,
     guard_sharded=None,
     guard_reduce_axis=None,
+    compute_dtype=None,
 ):
     """Clients one after another as a ``lax.scan`` (the round body of the
     whole-mesh-per-client sessions, ``spmd_sp.py``/``spmd_ep.py``), with
@@ -376,13 +378,30 @@ def scan_weighted_clients(
     ``rejected_updates`` count — the same semantics the client-axis
     shard bodies compile in.  ``guard_sharded``/``guard_reduce_axis``
     select the cross-stage guard flavor (the pipeline session: per-stage
-    slice stats all-reduced along ``pp`` — :func:`guard_client_update`)."""
+    slice stats all-reduced along ``pp`` — :func:`guard_client_update`).
+
+    ``compute_dtype`` (amp residency, ``algorithm_kwargs.amp_resident``)
+    casts the f32 master to the compute dtype ONCE here, before the
+    client scan: the per-kernel ``_cast_for_compute`` inside the scan
+    body then sees already-bf16 leaves (``astype`` is the identity), so
+    the whole scan runs convert-free, client momentum follows the
+    compute dtype (``optax`` inits from the params it is handed), and
+    the weighted f32 accumulation below re-applies the master update
+    exactly once per round — the classic mixed-precision recipe.  The
+    guard compares each client against the cast view it actually
+    started from.  ``None`` preserves the per-kernel-cast path
+    bit-exactly."""
+    train_globals = (
+        tree_cast(global_params, compute_dtype)
+        if compute_dtype is not None
+        else global_params
+    )
 
     def body(acc, xs):
         cdata, cval, weight, rng = xs
         rng, _ = jax.random.split(rng)
         params, summed = scan_local_epochs(
-            engine, epochs, global_params, cdata, rng,
+            engine, epochs, train_globals, cdata, rng,
             val_data=cval if cval else None,
         )
         # train-metric mask from the PRE-guard weight (the dense path's
@@ -393,7 +412,7 @@ def scan_weighted_clients(
             acc_params, acc_metrics, acc_w, acc_rej = acc
             weight, summed = guard_client_update(
                 params,
-                global_params,
+                train_globals,
                 weight,
                 summed,
                 max_update_norm,
@@ -800,9 +819,25 @@ class SpmdFedAvgSession(TraceCounterMixin):
         self._ckpt.register_finalizer("roundtrace", self._trace.close)
         self._ckpt_queued_round: int | None = None
 
+        # amp residency (algorithm_kwargs.amp_resident, default on under
+        # use_amp): the round programs cast the f32 master to the compute
+        # dtype ONCE per round and carry bf16 params/activations/deltas
+        # through the client scan, applying the f32 master update once in
+        # the aggregation epilogue.  `amp_resident: false` preserves the
+        # legacy per-kernel-cast path bit-exactly (parity pins + fallback).
+        self._amp_resident = (
+            self.engine.model_ctx.compute_dtype != jnp.float32
+            and bool(config.algorithm_kwargs.get("amp_resident", True))
+        )
+
         self._data, self._dataset_sizes, self.n_batches = stack_client_data(
             config, dataset_collection, practitioners, self.n_slots
         )
+        # residency satellite: batch INPUT leaves stored in the compute
+        # dtype once at placement — the per-step _cast_for_compute in the
+        # loss path then sees already-cast leaves (astype is the identity,
+        # so this is bit-identical to casting at use)
+        self._data = self._hoist_batch_cast(self._data)
 
         # ---- shardings ----
         if self._fsdp:
@@ -846,7 +881,8 @@ class SpmdFedAvgSession(TraceCounterMixin):
             )
             if val is not None:
                 self._val_data = put_sharded(
-                    val, NamedSharding(self.mesh, self._slot_spec)
+                    self._hoist_batch_cast(val),
+                    NamedSharding(self.mesh, self._slot_spec),
                 )
 
         # per-client rng fold chain, device-resident end to end: the old
@@ -1159,9 +1195,24 @@ class SpmdFedAvgSession(TraceCounterMixin):
         quant_level = self.quantization_level
         guard_active = self._update_guard
         max_update_norm = self._max_update_norm
+        compute_dtype = engine.model_ctx.compute_dtype
+        # amp residency (algorithm_kwargs.amp_resident, default on under
+        # use_amp): cast the f32 master to the compute dtype ONCE per
+        # round and fold the [S_pad] weight row into a flat ParamVec
+        # epilogue.  The FSDP layout keeps the per-leaf epilogue (its
+        # psum_scatter needs per-leaf sums) but still gets the
+        # once-per-round cast, applied to the LOCAL shard so the
+        # all_gather moves bf16.
+        resident = self._amp_resident
+        resident_fold = resident and not self._fsdp
 
-        def local_train(global_params, data, weight, rng, val=None):
-            """One client slot's round contribution."""
+        def train_one(global_params, data, weight, rng, val=None):
+            """One client slot: trained params (post-codec), effective
+            weight, pre-reduction metrics.  ``global_params`` is whatever
+            view the shard body hands over — the f32 master on the
+            legacy path, the once-per-round bf16 cast under amp
+            residency (the codec delta and the guard then both compare
+            against the params the client actually started from)."""
             rng, quant_rng = jax.random.split(rng)
             params, summed = scan_local_epochs(
                 engine, epochs, global_params, data, rng, val_data=val
@@ -1184,6 +1235,14 @@ class SpmdFedAvgSession(TraceCounterMixin):
                 weight, summed = guard_client_update(
                     params, global_params, weight, summed, max_update_norm
                 )
+            return params, weight, summed
+
+        def local_train(global_params, data, weight, rng, val=None):
+            """One client slot's round contribution (the per-leaf
+            weighted path: legacy, FSDP, and the buffered twin)."""
+            params, weight, summed = train_one(
+                global_params, data, weight, rng, val
+            )
             # weighted contribution; unselected slots contribute zero
             contribution = jax.tree.map(
                 lambda p: p.astype(jnp.float32) * weight, params
@@ -1215,6 +1274,10 @@ class SpmdFedAvgSession(TraceCounterMixin):
             def shard_body(global_params, data, val, weights, rngs):
                 params_in = global_params  # per-device (possibly sharded) view
                 if self._fsdp:
+                    if resident:
+                        # cast the LOCAL shard first: the gather then
+                        # moves bf16 — half the collective bytes
+                        global_params = tree_cast(global_params, compute_dtype)
                     # materialize full params for local training; XLA frees
                     # the gathered copy after the last use
                     global_params = {
@@ -1223,8 +1286,111 @@ class SpmdFedAvgSession(TraceCounterMixin):
                         else v
                         for k, v in global_params.items()
                     }
+                elif resident:
+                    # THE residency cast: master→compute once per round
+                    # (per horizon chunk under fusion) — every per-kernel
+                    # _cast_for_compute inside the client scan below then
+                    # sees already-bf16 leaves (astype is the identity),
+                    # and the f32 master update happens once in the
+                    # epilogue
+                    global_params = tree_cast(global_params, compute_dtype)
                 slots_local = weights.shape[0]
                 mb = chunk_size(slots_local)
+
+                if resident_fold:
+                    # flat ParamVec epilogue: each chunk's [mb]-stacked
+                    # trained params contract against the weight row as
+                    # ONE [mb, D] f32 matvec (ops/pytree.py) instead of
+                    # broadcasting weights across every param-shaped
+                    # tensor — the 26.8 GiB broadcast + 17.1 GiB multiply
+                    # families collapse to a [D] accumulator
+                    layout = ParamVecLayout.of(params_in)
+
+                    def run_slots_res(d, w, r, v):
+                        return jax.vmap(
+                            train_one, in_axes=(None, 0, 0, 0, 0)
+                        )(global_params, d, w, r, v if v else None)
+
+                    if mb == slots_local:
+                        stack, eff_w, metrics = run_slots_res(
+                            data, weights, rngs, val
+                        )
+                        local_vec = flat_stack_weighted_sum(stack, eff_w)
+                        metrics = jax.tree.map(lambda m: jnp.sum(m), metrics)
+                    else:
+                        n_chunks = slots_local // mb
+
+                        def to_chunks(tree):
+                            return jax.tree.map(
+                                lambda x: x.reshape(
+                                    n_chunks, mb, *x.shape[1:]
+                                ),
+                                tree,
+                            )
+
+                        def chunk_body(acc, chunk):
+                            data_k, v_k, w_k, r_k = chunk
+                            stack, eff_w, met = run_slots_res(
+                                data_k, w_k, r_k, v_k
+                            )
+                            acc_vec, acc_met = acc
+                            acc_vec = acc_vec + flat_stack_weighted_sum(
+                                stack, eff_w
+                            )
+                            acc_met = jax.tree.map(
+                                lambda a, m: a + jnp.sum(m), acc_met, met
+                            )
+                            return (acc_vec, acc_met), None
+
+                        chunks = (
+                            to_chunks(data),
+                            to_chunks(val),
+                            to_chunks(weights),
+                            to_chunks(rngs),
+                        )
+                        _, _, met_shapes = jax.eval_shape(
+                            lambda d, v, w, r: run_slots_res(d, w, r, v),
+                            *jax.tree.map(lambda x: x[0], chunks),
+                        )
+                        init = (
+                            jnp.zeros((layout.size,), jnp.float32),
+                            jax.tree.map(
+                                lambda s: jnp.zeros((), s.dtype), met_shapes
+                            ),
+                        )
+                        (local_vec, metrics), _ = jax.lax.scan(
+                            chunk_body, init, chunks
+                        )
+                    global_vec = jax.lax.psum(local_vec, axis_name="clients")
+                    # f32 sums split back through the static layout: the
+                    # one divide + master write-back per round
+                    global_sum = layout.split(global_vec, cast=False)
+                    if guard_active:
+                        metrics = dict(metrics)
+                        total_weight = jax.lax.psum(
+                            metrics.pop("_eff_weight"), axis_name="clients"
+                        )
+                        new_global = guarded_average(
+                            global_sum, total_weight, params_in
+                        )
+                    else:
+                        total_weight = jax.lax.psum(
+                            jnp.sum(weights), axis_name="clients"
+                        )
+                        new_global = jax.tree.map(
+                            lambda s, g: (
+                                s / jnp.maximum(total_weight, 1e-12)
+                            ).astype(g.dtype),
+                            global_sum,
+                            params_in,
+                        )
+                    metrics = jax.tree.map(
+                        lambda m: jax.lax.psum(
+                            jnp.sum(m), axis_name="clients"
+                        ),
+                        metrics,
+                    )
+                    return new_global, metrics
 
                 def run_slots(d, w, r, v):
                     return jax.vmap(
@@ -1381,6 +1547,12 @@ class SpmdFedAvgSession(TraceCounterMixin):
             return bucket_contrib, bucket_weight, summed
 
         def buffered_shard_body(global_params, data, val, weights, delays, rngs):
+            if resident:
+                # same once-per-round residency cast as the synchronous
+                # body; the pending-ring epilogue keeps its per-leaf f32
+                # bucket layout (the ring is a round-spanning carry), so
+                # only the training interior changes dtype
+                global_params = tree_cast(global_params, compute_dtype)
             slots_local = weights.shape[0]
             mb = chunk_size(slots_local)
             onehot = jax.nn.one_hot(delays, depth + 1, dtype=jnp.float32)
@@ -2789,6 +2961,35 @@ class SpmdFedAvgSession(TraceCounterMixin):
     def host_sync_points(self) -> float:
         return self.host_sync_count / max(1, self.rounds_run)
 
+    @property
+    def _resident_dtype(self):
+        """The compute dtype when amp residency is on, else None — the
+        switch the whole-mesh round bodies (``scan_weighted_clients``,
+        the OBD scan) thread through."""
+        if getattr(self, "_amp_resident", False):
+            return self.engine.model_ctx.compute_dtype
+        return None
+
+    def _hoist_batch_cast(self, batches):
+        """amp residency: store the floating INPUT leaves of a batch tree
+        in the compute dtype (cast once at placement instead of per step
+        in-program).  ``astype`` is deterministic, so storing the cast is
+        bit-identical to casting at use; masks/targets are untouched —
+        metric counting stays exact f32."""
+        if not getattr(self, "_amp_resident", False):
+            return batches
+        if not isinstance(batches, dict) or "input" not in batches:
+            return batches
+        cdtype = self.engine.model_ctx.compute_dtype
+        batches = dict(batches)
+        batches["input"] = jax.tree.map(
+            lambda x: x.astype(cdtype)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            batches["input"],
+        )
+        return batches
+
     def _ensure_eval_batches(self):
         # test batches are device-resident and built once — rebuilding host
         # arrays per round re-uploads the whole test set every evaluation
@@ -2802,7 +3003,9 @@ class SpmdFedAvgSession(TraceCounterMixin):
             # the full array; JAX keeps the addressable shards), matching
             # _place_params
             self._eval_batches = put_sharded(
-                make_epoch_batches(test, self.config.batch_size),
+                self._hoist_batch_cast(
+                    make_epoch_batches(test, self.config.batch_size)
+                ),
                 self._replicated,
             )
         return self._eval_batches

@@ -121,6 +121,7 @@ class SpmdExpertParallelSession(SpmdFedAvgSession):
                 engine, epochs, global_params, data, weights, rngs,
                 metrics_shape, val_data=val if val else None,
                 guard_active=guard_active, max_update_norm=max_update_norm,
+                compute_dtype=self._resident_dtype,
             )
 
         # out_shardings pin the new globals to the stored expert layout so

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..method.fed_obd.obd_algorithm import get_module_blocks
+from ..ops.pytree import tree_cast
 from ..ops.quantization import nnadq_quantize_dequantize
 from ..utils.logging import get_logger
 from .mesh import put_sharded
@@ -307,12 +308,22 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             _, keep_ord = jax.lax.scan(body, jnp.float32(0.0), sizes_ord)
             return jnp.zeros(block_sizes.shape[0], bool).at[order].set(keep_ord)
 
-        def local_train(global_params, data, weight, rng, opt_state=None):
+        def local_train(
+            global_params, data, weight, rng, opt_state=None,
+            compute_global=None,
+        ):
             rng, quant_rng = jax.random.split(rng)
+            if compute_global is None:
+                compute_global = global_params
             # phase 1: optimizer rebuilt per round (opt_state None); phase 2:
-            # reuse_learning_rate continuation from the carried state
+            # reuse_learning_rate continuation from the carried state.
+            # Under AMP residency ``compute_global`` is the ONE compute-dtype
+            # cast of the broadcast (made outside the client scan): training
+            # runs bf16-resident, while the deltas, the keep_mask scores and
+            # the dropped-block fallback below stay anchored to the f32
+            # broadcast — dropped blocks never accumulate cast rounding.
             params, opt_out, summed = scan_local_epochs_carry(
-                engine, epochs, global_params, data, rng, opt_state
+                engine, epochs, compute_global, data, rng, opt_state
             )
 
             selected = (weight > 0).astype(jnp.float32)
@@ -394,17 +405,27 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 mb -= 1
             return mb
 
+        cdtype = self._resident_dtype
+
         def round_program(global_params, opt_state_s, weights, rngs, bcast_rng, data):
             def shard_body(global_params, opt_state_s, data, weights, rngs, bcast_rng):
                 slots_local = weights.shape[0]
                 mb = chunk_size(slots_local)
+                # AMP residency: ONE cast of the broadcast per phase program
+                # (outside the chunk scan) — every slot trains from the same
+                # compute-dtype view instead of re-converting per kernel
+                compute_global = (
+                    tree_cast(global_params, cdtype)
+                    if cdtype is not None
+                    else global_params
+                )
 
                 def run_slots(d, w, r, o):
                     # phase 1: o is None (optimizer rebuilt per round)
                     return jax.vmap(
                         local_train,
-                        in_axes=(None, 0, 0, 0, 0 if phase_two else None),
-                    )(global_params, d, w, r, o)
+                        in_axes=(None, 0, 0, 0, 0 if phase_two else None, None),
+                    )(global_params, d, w, r, o, compute_global)
 
                 if mb == slots_local:
                     # phase 1 rebuilds optimizers per round: the carried
@@ -951,11 +972,16 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
     # ------------------------------------------------------------------
     def _opt_state_template(self):
         """Abstract [S, ...] optimizer-state pytree (structure + shapes,
-        nothing computed)."""
+        nothing computed).  Under AMP residency clients train — and init
+        their optimizers — from the compute-dtype view, so the carried
+        buffer (and anything restored into it) follows that dtype; the
+        shape-checked ``_load_opt_state`` cast retargets older f32 saves
+        automatically."""
+        cdtype = self._resident_dtype
         return jax.eval_shape(
             lambda p: jax.vmap(
                 self.engine.optimizer.init, in_axes=None, axis_size=self.n_slots
-            )(p),
+            )(p if cdtype is None else tree_cast(p, cdtype)),
             jax.eval_shape(lambda: self.engine.init_params(self.config.seed)),
         )
 
@@ -1160,10 +1186,15 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             # client-axis, replicated whole-mesh): the phase programs
             # DONATE this carry, and a compiler-chosen placement here
             # would alias against the pinned carry output with mismatched
-            # per-device sizes
+            # per-device sizes.  Residency: init from the compute-dtype
+            # view so the donated buffer byte-sizes match the in-program
+            # optimizer.init over bf16 params (_opt_state_template)
+            cdtype = self._resident_dtype
             return jax.jit(
                 jax.vmap(
-                    self.engine.optimizer.init,
+                    lambda p: self.engine.optimizer.init(
+                        p if cdtype is None else tree_cast(p, cdtype)
+                    ),
                     in_axes=None,
                     axis_size=self.n_slots,
                 ),

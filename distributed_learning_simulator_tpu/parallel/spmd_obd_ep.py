@@ -27,13 +27,15 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..engine.engine import ComputeEngine
+from ..ops.pytree import tree_cast
 from .mesh import use_mesh
 from .spmd import guarded_average
 from .spmd_obd import SpmdFedOBDSession, _masked_slot_merge
 
 
 def obd_scan_round_program(
-    local_train, qdq, phase_two: bool, guard_active: bool = False
+    local_train, qdq, phase_two: bool, guard_active: bool = False,
+    compute_dtype=None,
 ):
     """The whole-mesh-per-client FedOBD round: clients as a ``lax.scan``
     with on-device weighted accumulation and the quantized broadcast —
@@ -52,17 +54,28 @@ def obd_scan_round_program(
       becomes the sum of the guard's per-slot EFFECTIVE weights
       (``_eff_weight`` accumulated through the metric sum) and a
       zero-survivor round keeps the old global
-      (:func:`spmd.guarded_average`)."""
+      (:func:`spmd.guarded_average`).
+
+    ``compute_dtype`` (AMP residency): cast the broadcast ONCE before the
+    client scan and hand every client the same compute-dtype view; the
+    f32 anchors (deltas, dropped-block fallback, exact average) stay on
+    ``global_params``."""
 
     def round_program(
         global_params, opt_state_s, weights, rngs, bcast_rng, data
     ):
+        compute_global = (
+            tree_cast(global_params, compute_dtype)
+            if compute_dtype is not None
+            else global_params
+        )
         zero_params = jax.tree.map(
             lambda g: jnp.zeros(g.shape, jnp.float32), global_params
         )
         first = jax.tree.map(lambda x: x[0], data)
         _, _, met_shapes = jax.eval_shape(
-            local_train, global_params, first, weights[0], rngs[0], None
+            local_train, global_params, first, weights[0], rngs[0], None,
+            compute_global=compute_global,
         )
         zero_metrics = jax.tree.map(
             lambda s: jnp.zeros((), s.dtype), met_shapes
@@ -75,7 +88,8 @@ def obd_scan_round_program(
                 cdata, w, r = xs
                 opt = None
             contrib, opt_out, met = local_train(
-                global_params, cdata, w, r, opt
+                global_params, cdata, w, r, opt,
+                compute_global=compute_global,
             )
             acc_sum, acc_met = acc
             acc_sum = jax.tree.map(lambda a, c: a + c, acc_sum, contrib)
@@ -210,7 +224,8 @@ class SpmdFedOBDExpertParallelSession(SpmdFedOBDSession):
 
     def _wrap_phase_program(self, local_train, qdq, phase_two: bool):
         round_program = obd_scan_round_program(
-            local_train, qdq, phase_two, guard_active=self._update_guard
+            local_train, qdq, phase_two, guard_active=self._update_guard,
+            compute_dtype=self._resident_dtype,
         )
         # pin the aggregate AND broadcast to the stored expert layout so
         # donated round-over-round buffers never reshard; jit, gather

@@ -103,7 +103,8 @@ class SpmdFedOBDSequenceParallelSession(
     def _wrap_phase_program(self, local_train, qdq, phase_two: bool):
         mesh = self.mesh
         scan_round = obd_scan_round_program(
-            local_train, qdq, phase_two, guard_active=self._update_guard
+            local_train, qdq, phase_two, guard_active=self._update_guard,
+            compute_dtype=self._resident_dtype,
         )
 
         def round_program(

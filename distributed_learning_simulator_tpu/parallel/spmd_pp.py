@@ -156,6 +156,7 @@ class SpmdPipelineSession(SingleDeviceEvalMixin, SpmdFedAvgSession):
                     max_update_norm=self._max_update_norm,
                     guard_sharded=guard_sharded,
                     guard_reduce_axis="pp",
+                    compute_dtype=self._resident_dtype,
                 )
 
             return shard_map_compat(

@@ -173,6 +173,7 @@ class SpmdSequenceParallelSession(SingleDeviceEvalMixin, SpmdFedAvgSession):
                     metrics_shape, val_data=val if val else None,
                     guard_active=guard_active,
                     max_update_norm=max_update_norm,
+                    compute_dtype=self._resident_dtype,
                 )
 
             def seq_specs(tree):

@@ -26,7 +26,13 @@ absent entirely.  This module is the one place that dance lives:
   achieved-vs-roofline MFU (``tools/costview`` renders this);
 * :func:`hlo_op_histogram` — opcode-level output-bytes histogram over
   the optimized HLO, the attribution view that names WHICH op family
-  eats the round (``docs/cost_attribution_large_scale.md``).
+  eats the round (``docs/cost_attribution_large_scale.md``);
+* :func:`hlo_family_bytes` — one family's summed output bytes from that
+  histogram; ``cost_summary`` rides it to report ``convert_bytes`` (the
+  dtype-cast traffic AMP residency exists to kill) as an EXTRA row key
+  next to the :data:`LEDGER_FIELDS` — the ledger schema itself is
+  frozen (tests pin it), extra keys flow through ``program_cost``
+  events and ``cost_ledger()`` rows to ``tools/costview`` budgets.
 
 House rules: pure host-side metadata — no dispatches, no host syncs, no
 device-array reads; every function that rides a hot path
@@ -138,6 +144,15 @@ def cost_summary(compiled) -> dict[str, float]:
             ("generated_code_bytes", "generated_code_size_in_bytes"),
         ):
             out[field] = float(getattr(mem, attr, 0) or 0)
+    try:
+        # dtype-cast traffic: the op family AMP residency targets; extra
+        # key (NOT in LEDGER_FIELDS — that schema is pinned), absent when
+        # the backend cannot render HLO text
+        out["convert_bytes"] = hlo_family_bytes(
+            compiled.as_text(), "convert"
+        )
+    except Exception:  # noqa: BLE001 — diagnostics never raise
+        pass
     return out
 
 
@@ -295,6 +310,20 @@ def hlo_op_histogram(hlo_text: str, top: int = 0) -> list[dict[str, Any]]:
         )
     ]
     return ordered[:top] if top else ordered
+
+
+def hlo_family_bytes(hlo_text: str, family: str) -> float:
+    """Summed output bytes of ONE opcode family over optimized HLO text
+    (``convert``, ``broadcast``, ...).  Fusion sub-kinds count into their
+    base family (``fusion`` matches ``fusion:kLoop`` etc.)."""
+    prefix = family + ":"
+    return float(
+        sum(
+            row["output_bytes"]
+            for row in hlo_op_histogram(hlo_text)
+            if row["op"] == family or row["op"].startswith(prefix)
+        )
+    )
 
 
 def merge_ledgers(rows: Iterable[dict[str, float]]) -> dict[str, float]:

@@ -122,12 +122,17 @@ python3 -m tools.tracedump "$TRACE_SMOKE/spmd/server/trace.jsonl" \
   --assert-budget "dispatches_per_round<=1" \
   --assert-budget "retrace_events==0"
 # costwatch gate (tools/costview): the same fused smoke trace must hold
-# the MEMORY budget — program temporaries (~12 MB on this shape; bound
-# is generous headroom, a regression shows up as an order of magnitude)
-# and the peak HBM watermark (0 on CPU hosts, sampled live on TPU)
+# the MEMORY budget — program temporaries (~12 MB on this shape; the
+# bound is ~2x headroom so a regression shows up, ratcheted down from
+# the pre-residency 200 MB ceiling), the peak HBM watermark (0 on CPU
+# hosts, sampled live on TPU), and the convert-family bytes (the f32
+# smoke records only index converts, ~2.6 KB; a single accidental
+# param-shaped cast on this shape is ~245 KB, so 100 KB catches the
+# per-kernel cast family reappearing)
 python3 -m tools.costview "$TRACE_SMOKE/spmd/server/trace.jsonl" \
-  --assert-budget "temp_bytes<=200000000" \
-  --assert-budget "peak_hbm_bytes<=20000000000"
+  --assert-budget "temp_bytes<=25000000" \
+  --assert-budget "peak_hbm_bytes<=20000000000" \
+  --assert-budget "convert_bytes<=100000"
 python3 -m tools.tracedump "$TRACE_SMOKE/sequential/server/trace.jsonl" \
   --format json > /dev/null
 python3 -m tools.tracedump "$TRACE_SMOKE/ep/server/trace.jsonl" \

@@ -31,6 +31,8 @@ def _patch_success(monkeypatch, bench, tmp_path):
                 "outputs": 0.4,
                 "temporaries": 1.89,
             },
+            "amp_path": "resident",
+            "convert_bytes_per_round": 1234.0,
         },
     )
     monkeypatch.setattr(
@@ -250,6 +252,8 @@ def test_bench_main_prints_compact_headline_and_spills_detail(
         "dense_shape",
         "long_context",
         "large_scale",
+        "amp_path",
+        "convert_bytes_per_round",
         "agg_path",
         "aggregation",
         "headline_explained",
@@ -278,6 +282,10 @@ def test_bench_main_prints_compact_headline_and_spills_detail(
     ):
         assert field in payload, field
     assert payload["agg_path"] in ("flat", "per_tensor")
+    # AMP path + compiled convert-family bytes mirror the large_scale
+    # leg's measured fields
+    assert payload["amp_path"] == "resident"
+    assert payload["convert_bytes_per_round"] == 1234.0
     # selection-aware gather: the A/B carries both paths' rounds/sec and
     # wasted-compute fractions; the top-level pair mirrors the default
     # (gather) path
@@ -381,6 +389,10 @@ def test_bench_main_survives_measurement_failures(monkeypatch, tmp_path):
     assert payload["vs_baseline"] == 0.0
     assert "error" in payload["long_context"]
     assert "error" in payload["large_scale"]
+    # amp_path still records the configured path even when the leg
+    # failed; convert bytes degrade to -1 (the -1/absent-never contract)
+    assert payload["amp_path"] == "resident"
+    assert payload["convert_bytes_per_round"] == -1.0
     # agg_path still records the default path even when timing it failed
     assert payload["agg_path"] == "flat"
     assert "error" in payload["aggregation"]

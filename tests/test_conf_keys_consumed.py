@@ -80,6 +80,8 @@ CONSUMERS: dict[tuple[str, str], list[str]] = {
     ],
     ("dataset_kwargs", "max_len"): ["data/registry.py"],
     ("dataset_kwargs", "name"): ["data/registry.py"],
+    ("dataset_kwargs", "train_size"): ["data/registry.py"],
+    ("dataset_kwargs", "vocab_size"): ["data/registry.py"],
     ("dataset_kwargs", "tokenizer"): ["data/tokenizer.py", "data/registry.py"],
     ("dataset_kwargs.tokenizer", "type"): ["data/tokenizer.py"],
     ("endpoint_kwargs", "server"): ["topology/quantized_endpoint.py"],
@@ -87,6 +89,7 @@ CONSUMERS: dict[tuple[str, str], list[str]] = {
     ("endpoint_kwargs.server", "weight"): ["topology/quantized_endpoint.py"],
     ("endpoint_kwargs.worker", "weight"): ["topology/quantized_endpoint.py"],
     ("extra_hyper_parameters", "num_neighbor"): ["method/fed_aas/__init__.py"],
+    ("extra_hyper_parameters", "remat_policy"): ["engine/engine.py"],
     ("model_kwargs", "d_model"): ["models/text.py"],
     ("model_kwargs", "nhead"): ["models/text.py"],
     ("model_kwargs", "num_encoder_layer"): ["models/text.py"],

@@ -1,0 +1,325 @@
+"""bf16-resident round programs (``algorithm_kwargs.amp_resident``, default
+on under ``use_amp``) and policy-driven remat
+(``extra_hyper_parameters.remat_policy``).
+
+Residency moves the f32→bf16 master cast from inside every client kernel
+(``_cast_for_compute`` per forward) to ONE cast per round program, carries
+bf16 through the client scan, and applies the f32 master update once in the
+aggregation epilogue (flat ParamVec scale-and-accumulate on the non-FSDP
+client-axis path).  The pins below hold that move to its contract:
+
+* ``amp_resident: false`` keeps the legacy per-kernel-cast path and stays
+  deterministic (bit-exact across identical runs);
+* resident vs per-kernel is a float-tolerance trajectory change only (both
+  run the same bf16 matmuls — only the cast PLACEMENT differs), and both
+  stay within the same envelope of the f32 reference;
+* the scheduling transforms stay pure under residency: selection-gather vs
+  dense and H=1 vs H=4 horizon fusion remain BIT-exact;
+* a remat policy is a numerical no-op (params bit-exact vs bare
+  ``jax.checkpoint``) that only trades the compiled ledger's temporaries;
+* the transport codecs (QSGD / NNADQ) accept bf16 deltas and hold their
+  quantization error bounds (plus one bf16 ulp for the dtype roundtrip).
+
+Tolerances and the temp_bytes ordering below were measured on XLA:CPU —
+see docs/cost_attribution_large_scale.md for the large-shape figures.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import fed_avg_config
+from distributed_learning_simulator_tpu.training import _build_task, train
+
+
+def _config(save_dir, workers=2, rounds=3, use_amp=True, resident=None,
+            horizon=1, gather=None, k=None, extra=None, **overrides):
+    algorithm_kwargs = dict(overrides.pop("algorithm_kwargs", {}))
+    if resident is not None:
+        algorithm_kwargs["amp_resident"] = resident
+    if horizon != 1:
+        algorithm_kwargs["round_horizon"] = horizon
+    if gather is not None:
+        algorithm_kwargs["selection_gather"] = gather
+    if k is not None:
+        algorithm_kwargs["random_client_number"] = k
+    config = fed_avg_config(
+        executor="spmd",
+        worker_number=workers,
+        round=rounds,
+        batch_size=32,
+        epoch=1,
+        use_amp=use_amp,
+        save_dir=save_dir,
+        dataset_kwargs={
+            "train_size": 32 * workers,
+            "val_size": 16,
+            "test_size": 32,
+        },
+        algorithm_kwargs=algorithm_kwargs,
+        extra_hyper_parameters=dict(extra or {}),
+        **overrides,
+    )
+    config.load_config_and_process()
+    return config
+
+
+def _final_params(save_dir, round_number):
+    path = os.path.join(
+        save_dir, "aggregated_model", f"round_{round_number}.npz"
+    )
+    with np.load(path) as blob:
+        return {k: blob[k] for k in blob.files}
+
+
+def _build_session(config):
+    from distributed_learning_simulator_tpu.training import (
+        resolve_spmd_session_class,
+    )
+
+    ctx = _build_task(config)
+    cls = resolve_spmd_session_class(config)
+    return cls(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+
+
+def _assert_bit_exact(pa, pb):
+    assert pa.keys() == pb.keys()
+    for key in pa:
+        np.testing.assert_array_equal(pa[key], pb[key], err_msg=key)
+
+
+# ------------------------------------------------------- path resolution
+def test_amp_resident_flag_resolution(tmp_session_dir):
+    """Residency is the DEFAULT under use_amp; ``amp_resident: false`` and
+    plain f32 both resolve to the non-resident path."""
+    on = _build_session(_config("flag_on"))
+    assert on._amp_resident is True
+    off = _build_session(_config("flag_off", resident=False))
+    assert off._amp_resident is False
+    f32 = _build_session(_config("flag_f32", use_amp=False))
+    assert f32._amp_resident is False
+
+
+# ------------------------------------------------------- off-path pin
+def test_amp_resident_off_path_bit_exact(tmp_session_dir):
+    """The escape hatch must stay trustworthy: two identical runs on the
+    legacy per-kernel-cast path reproduce each other bit-exactly (params
+    AND metrics), so flipping residency off recovers pre-residency
+    behaviour deterministically."""
+    ra = train(_config("off_a", resident=False))
+    rb = train(_config("off_b", resident=False))
+    for rn in ra["performance"]:
+        assert (
+            ra["performance"][rn]["test_loss"]
+            == rb["performance"][rn]["test_loss"]
+        ), rn
+        assert (
+            ra["performance"][rn]["test_accuracy"]
+            == rb["performance"][rn]["test_accuracy"]
+        ), rn
+    _assert_bit_exact(_final_params("off_a", 3), _final_params("off_b", 3))
+
+
+# ------------------------------------------------------- tolerance pin
+@pytest.mark.slow  # whole-run parity e2e (3 sessions) — tier-1 headroom
+def test_resident_vs_per_kernel_trajectory_tolerance(tmp_session_dir):
+    """Residency changes WHERE the bf16 cast happens, not what runs in
+    bf16 — resident and per-kernel trajectories agree to bf16 noise, and
+    both stay inside the same envelope of the f32 reference.  Measured
+    divergence after 3 rounds on this shape: max |Δ| ≈ 2.5e-3 (resident
+    vs per-kernel) and ≈ 3.9e-3 (either vs f32)."""
+    train(_config("res_on", resident=True))
+    train(_config("res_off", resident=False))
+    train(_config("res_f32", use_amp=False))
+    p_on = _final_params("res_on", 3)
+    p_off = _final_params("res_off", 3)
+    p_f32 = _final_params("res_f32", 3)
+    for key in p_on:
+        np.testing.assert_allclose(
+            p_on[key], p_off[key], atol=1e-2, err_msg=key
+        )
+        np.testing.assert_allclose(
+            p_on[key], p_f32[key], atol=2e-2, err_msg=key
+        )
+        np.testing.assert_allclose(
+            p_off[key], p_f32[key], atol=2e-2, err_msg=key
+        )
+
+
+# ---------------------------------------------- scheduling purity pins
+def test_gather_vs_dense_parity_under_residency(tmp_session_dir):
+    """Selection-gather stays a pure scheduling change when the scan body
+    is bf16-resident: 8 workers (one slot per device), k=5 — bit-exact
+    params vs the dense zero-masking path."""
+    train(_config("res_dense", workers=8, gather=False, k=5))
+    train(_config("res_gather", workers=8, gather=True, k=5))
+    _assert_bit_exact(
+        _final_params("res_dense", 3), _final_params("res_gather", 3)
+    )
+
+
+def test_h1_vs_h4_parity_under_residency(tmp_session_dir):
+    """Horizon fusion stays a pure scheduling change under residency: the
+    per-chunk master cast inside the fused H=4 scan reproduces the
+    per-round cast bit-exactly."""
+    train(_config("res_h1", rounds=4))
+    train(_config("res_h4", rounds=4, horizon=4))
+    _assert_bit_exact(_final_params("res_h1", 4), _final_params("res_h4", 4))
+
+
+# ------------------------------------------------------- remat policy
+def test_remat_policy_resolution():
+    """``remat_policy`` implies remat, resolves through
+    ``jax.checkpoint_policies``, and an unknown name fails loudly with
+    the valid names in the message."""
+    from distributed_learning_simulator_tpu.data.registry import (
+        global_dataset_factory,
+    )
+    from distributed_learning_simulator_tpu.engine.engine import ComputeEngine
+    from distributed_learning_simulator_tpu.engine.hyper_parameter import (
+        HyperParameter,
+    )
+    from distributed_learning_simulator_tpu.models.registry import (
+        create_model_context,
+    )
+
+    dc = global_dataset_factory["MNIST"](train_size=32)
+    ctx = create_model_context("LeNet5", dc)
+
+    def engine_for(extra):
+        hp = HyperParameter(
+            epoch=1, batch_size=8, learning_rate=0.1, extra=extra
+        )
+        return ComputeEngine(ctx, hp, total_steps=1)
+
+    engine = engine_for({"remat_policy": "dots_saveable"})
+    assert engine.use_remat is True
+    assert engine.remat_policy is jax.checkpoint_policies.dots_saveable
+    assert engine_for({"remat": True}).remat_policy is None
+    with pytest.raises(ValueError, match="dots_saveable"):
+        engine_for({"remat_policy": "not_a_policy"})
+
+
+@pytest.mark.slow  # 2 e2e runs + 2 fresh compiles — tier-1 headroom
+def test_remat_policy_numerical_noop(tmp_session_dir):
+    """A checkpoint policy recomputes the identical forward — params after
+    2 rounds are BIT-exact vs bare ``jax.checkpoint`` — and only moves
+    the compiled ledger: on this shape ``dots_saveable`` temporaries
+    measure strictly below bare remat (3.46 MB vs 3.71 MB on XLA:CPU);
+    the pin is ``<=`` so an XLA that fuses them equal stays green."""
+    import contextlib
+
+    from distributed_learning_simulator_tpu.util.costwatch import (
+        cost_summary,
+    )
+
+    train(_config("remat_bare", rounds=2, extra={"remat": True}))
+    train(
+        _config(
+            "remat_dots",
+            rounds=2,
+            extra={"remat": True, "remat_policy": "dots_saveable"},
+        )
+    )
+    _assert_bit_exact(
+        _final_params("remat_bare", 2), _final_params("remat_dots", 2)
+    )
+
+    def round_temp_bytes(config):
+        session = _build_session(config)
+        for spec in session.shardcheck_programs():
+            if not spec.name.startswith("round"):
+                continue
+            mc = (
+                spec.mesh_context()
+                if getattr(spec, "mesh_context", None)
+                else contextlib.nullcontext()
+            )
+            with mc:
+                compiled = spec.jitted.lower(*spec.args).compile()
+            return cost_summary(compiled)["temp_bytes"]
+        raise AssertionError("no round program found")
+
+    bare = round_temp_bytes(
+        _config("remat_bare_t", rounds=2, extra={"remat": True})
+    )
+    dots = round_temp_bytes(
+        _config(
+            "remat_dots_t",
+            rounds=2,
+            extra={"remat": True, "remat_policy": "dots_saveable"},
+        )
+    )
+    assert dots <= bare, (dots, bare)
+
+
+# ------------------------------------------------------- codec on bf16
+def test_codec_roundtrip_bf16_deltas():
+    """The transport codecs run ON the resident dtype: QSGD and NNADQ
+    accept bf16 delta tensors, return finite bf16, and hold their
+    quantization error bounds plus one bf16 ulp for the dtype roundtrip
+    (bf16 eps = 2^-7 ≈ 0.0078)."""
+    from distributed_learning_simulator_tpu.ops.quantization import (
+        nnadq_quantize_dequantize,
+        qsgd_quantize_dequantize,
+    )
+
+    delta = (
+        jax.random.normal(jax.random.PRNGKey(0), (257, 33)) * 0.01
+    ).astype(jnp.bfloat16)
+    x32 = np.asarray(delta, np.float32)
+    scale = float(np.max(np.abs(x32)))
+
+    level = 64
+    q = qsgd_quantize_dequantize(delta, jax.random.PRNGKey(1), level)
+    assert q.dtype == jnp.bfloat16
+    q32 = np.asarray(q, np.float32)
+    assert np.all(np.isfinite(q32))
+    assert np.max(np.abs(q32 - x32)) <= scale / level + 0.008 * scale
+
+    deq, bits = nnadq_quantize_dequantize(delta, 0.01)
+    assert deq.dtype == jnp.bfloat16
+    d32 = np.asarray(deq, np.float32)
+    assert np.all(np.isfinite(d32))
+    assert 2 <= float(bits) <= 16
+    lo = float(np.min(x32))
+    span = max(float(np.max(x32)) - lo, 1e-12)
+    step = span / (2.0 ** float(bits) - 1.0)
+    assert np.max(np.abs(d32 - x32)) <= step / 2 + 0.008 * scale
+
+
+# ------------------------------------------------------- heavy e2e
+@pytest.mark.slow
+def test_amp_resident_e2e_learns(tmp_session_dir):
+    """Whole-run pin on the resident path: 4 clients, 10 rounds, 2 local
+    epochs on 1024 MNIST examples — the bf16-resident program must LEARN
+    (well above the 10% chance floor), not just run."""
+    config = fed_avg_config(
+        executor="spmd",
+        worker_number=4,
+        round=10,
+        batch_size=32,
+        epoch=2,
+        use_amp=True,
+        learning_rate=0.05,
+        save_dir="heavy",
+        dataset_kwargs={
+            "train_size": 1024,
+            "val_size": 64,
+            "test_size": 256,
+        },
+    )
+    config.load_config_and_process()
+    result = train(config)
+    final = result["performance"][10]
+    assert np.isfinite(final["test_loss"])
+    assert final["test_accuracy"] >= 0.3, final

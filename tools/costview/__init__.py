@@ -68,7 +68,10 @@ from tools.tracedump import (  # noqa: E402
 )
 
 #: budget keys whose INCREASE vs a ``--diff`` baseline is a regression
-COST_REGRESSION_KEYS = ("temp_bytes", "peak_hbm_bytes")
+#: (convert_bytes guards the AMP-residency win: dtype-cast traffic
+#: creeping back into a round program is a cost regression like any
+#: other)
+COST_REGRESSION_KEYS = ("temp_bytes", "peak_hbm_bytes", "convert_bytes")
 
 
 def chip_tables(chip: str, count: int = 1) -> tuple[float, float]:
@@ -113,6 +116,13 @@ def attribute(
                 field: float(record.get(field, 0.0) or 0.0)
                 for field in LEDGER_FIELDS
             }
+            # extra costwatch keys (outside the frozen ledger schema):
+            # convert-family bytes, present when the producing backend
+            # could render HLO text
+            if "convert_bytes" in record:
+                costs[program]["convert_bytes"] = float(
+                    record.get("convert_bytes") or 0.0
+                )
         elif ev == "event" and kind == "hbm":
             hbm_samples += 1
             hbm_live = float(record.get("bytes_in_use", 0) or 0)
@@ -155,7 +165,7 @@ def attribute(
     totals = merge_ledgers(programs.values())
 
     def _max(field: str) -> float:
-        return max((r[field] for r in programs.values()), default=0.0)
+        return max((r.get(field, 0.0) for r in programs.values()), default=0.0)
 
     budget = {
         "programs_total": len(programs),
@@ -177,6 +187,14 @@ def attribute(
             host_gap / round_seconds if round_seconds > 0 else 0.0, 6
         ),
     }
+    if any("convert_bytes" in r for r in programs.values()):
+        # only when the trace recorded it — a pre-convert-aware trace
+        # must not read as "zero convert traffic" (asserting a convert
+        # budget against one exits 2: unknown key, can't certify)
+        budget["convert_bytes"] = _max("convert_bytes")
+        budget["convert_bytes_total"] = sum(
+            r.get("convert_bytes", 0.0) for r in programs.values()
+        )
     return {
         "peak_flops": peak_flops,
         "hbm_bandwidth": hbm_bandwidth,
@@ -203,7 +221,13 @@ def diff_attributions(candidate: dict, baseline: dict) -> dict[str, Any]:
             "baseline": old,
             "delta": round(new - old, 6),
         }
-        if key in COST_REGRESSION_KEYS and new > old + 1e-9:
+        if (
+            key in COST_REGRESSION_KEYS
+            and new > old + 1e-9
+            # a key the baseline trace never recorded (e.g. convert_bytes
+            # before it existed) reads 0.0 here — not a regression signal
+            and key in baseline["budget"]
+        ):
             regressions.append(
                 f"cost regression: {key} rose {old:g} -> {new:g} "
                 f"(+{new - old:g})"
