@@ -36,6 +36,99 @@ def make_mesh(model_parallel: int = 1, devices=None) -> Mesh:
     return Mesh(grid, axis_names=("clients", "model"))
 
 
+def create_hybrid_device_mesh(
+    model_parallel: int = 1, devices=None, virtual_hosts: int | None = None
+) -> Mesh:
+    """A (hosts × chips) hybrid mesh: the ``clients`` axis spans hosts
+    (outer blocks ride DCN), the ``model`` axis stays within one host's
+    chips (ICI) — the t5x/maxtext hybrid layout (SNIPPETS [1]).  Client
+    slots land contiguously per host, which is exactly the
+    sharded-per-host layout ``PopulationStore`` persists, so a streamed
+    cohort's host→device path never crosses DCN.
+
+    On a real pod the blocks come from ``device.process_index``; jax's
+    own ``mesh_utils.create_hybrid_device_mesh`` is tried first and the
+    manual grouping is the fallback for backends whose device attributes
+    confuse it.  ``virtual_hosts`` carves a SINGLE process's device list
+    into contiguous per-"host" blocks instead — the
+    ``--xla_force_host_platform_device_count`` CI harness that exercises
+    this path end-to-end on CPU (tests/test_multihost.py).  Virtual
+    blocks preserve device order, so the grid equals ``make_mesh``'s and
+    results stay bit-identical to the flat layout."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    assert n % model_parallel == 0, (n, model_parallel)
+    if virtual_hosts is not None:
+        hosts = int(virtual_hosts)
+        assert hosts >= 1 and n % hosts == 0, (n, hosts)
+        per_host = n // hosts
+        assert per_host % model_parallel == 0, (per_host, model_parallel)
+        blocks = [
+            np.asarray(devices[h * per_host : (h + 1) * per_host]).reshape(
+                per_host // model_parallel, model_parallel
+            )
+            for h in range(hosts)
+        ]
+        grid = np.concatenate(blocks, axis=0)
+        return Mesh(grid, axis_names=("clients", "model"))
+    process_ids = sorted({d.process_index for d in devices})
+    hosts = len(process_ids)
+    if hosts <= 1:
+        return make_mesh(model_parallel=model_parallel, devices=devices)
+    per_host = n // hosts
+    assert per_host % model_parallel == 0, (per_host, model_parallel)
+    try:
+        from jax.experimental import mesh_utils
+
+        grid = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(per_host // model_parallel, model_parallel),
+            dcn_mesh_shape=(hosts, 1),
+            devices=devices,
+        )
+        return Mesh(grid, axis_names=("clients", "model"))
+    except Exception as exc:  # noqa: BLE001 — backend-specific attrs
+        from ..utils.logging import get_logger
+
+        get_logger().warning(
+            "mesh_utils.create_hybrid_device_mesh unavailable on this "
+            "backend (%s); grouping devices by process_index manually",
+            exc,
+        )
+    blocks = []
+    for pid in process_ids:
+        host_devices = [d for d in devices if d.process_index == pid]
+        assert len(host_devices) == per_host, (pid, len(host_devices))
+        blocks.append(
+            np.asarray(host_devices).reshape(
+                per_host // model_parallel, model_parallel
+            )
+        )
+    grid = np.concatenate(blocks, axis=0)
+    return Mesh(grid, axis_names=("clients", "model"))
+
+
+def broadcast_selection_rows(rows: np.ndarray) -> np.ndarray:
+    """Make host-built selection rows (cohort ids, weight rows) agree
+    across a pod: broadcast process 0's rows to everyone and ASSERT the
+    local rows matched — selection is seeded-deterministic, so a
+    mismatch means a diverged rng stream, which must fail loudly rather
+    than silently train different cohorts per host.  No-op (and no
+    collective) with a single process."""
+    rows = np.array(rows)
+    if jax.process_count() == 1:
+        return rows
+    from jax.experimental import multihost_utils
+
+    agreed = np.array(multihost_utils.broadcast_one_to_all(rows))
+    if not np.array_equal(agreed, rows):
+        raise RuntimeError(
+            "host-built selection rows diverged across processes "
+            f"(process {jax.process_index()} disagrees with process 0) — "
+            "per-host rng streams are out of sync"
+        )
+    return agreed
+
+
 def initialize_multihost(
     coordinator_address: str | None = None,
     num_processes: int | None = None,

@@ -661,9 +661,49 @@ class SpmdFedAvgSession(TraceCounterMixin):
                 " back to the dense O(population) round path",
                 reason,
             )
+        # ---- streamed populations (util/population.py) ----
+        # ``algorithm_kwargs.population_store: streamed`` keeps the full
+        # population's stacked client state HOST-resident and places only
+        # the round's selected ``[S_pad]`` cohort (the horizon's union of
+        # ``[H, S_pad]`` ids under fusion, fetched once per chunk) —
+        # double-buffered, so round r+1's transfer hides under round r's
+        # dispatched program.  The cohort-shaped programs are the SAME
+        # shape-polymorphic dense programs traced at ``s_pad``, and the
+        # per-client rng streams are fold_in-indexed by WORKER ID — the
+        # same two facts that made selection gather bit-exact make the
+        # streamed path bit-exact (pinned, tests/test_population_store).
+        # Round MEMORY now scales with participants the way gather made
+        # round COMPUTE scale: HBM watermarks stay flat as the population
+        # grows (bench ``population_scaling``).
+        store_mode = (
+            str(
+                config.algorithm_kwargs.get("population_store", "device")
+                or "device"
+            )
+            .strip()
+            .lower()
+        )
+        if store_mode not in ("device", "streamed"):
+            raise ValueError(
+                "algorithm_kwargs.population_store must be 'device' or"
+                f" 'streamed', got {store_mode!r}"
+            )
+        self._population_streamed = store_mode == "streamed"
+        if self._population_streamed:
+            streamed_reason = self._population_store_unsupported_reason()
+            if streamed_reason is not None:
+                raise ValueError(
+                    "algorithm_kwargs.population_store=streamed is"
+                    f" unsupported here: {streamed_reason} — drop the"
+                    " knob for this session"
+                )
+            # the device-gather twin reads slot stacks that are no longer
+            # resident; under streaming the placed cohort IS the
+            # selection, so the dense-shaped program runs at s_pad
+            self._selection_gather = False
         self.s_pad = (
             client_slots(self._selected_per_round, self.mesh, slot_axes)
-            if self._selection_gather
+            if (self._selection_gather or self._population_streamed)
             else self.n_slots
         )
         if self._client_chunk_auto:
@@ -859,9 +899,29 @@ class SpmdFedAvgSession(TraceCounterMixin):
             for k, spec in self._param_specs.items()
         }
 
-        self._data = put_sharded(
-            self._data, NamedSharding(self.mesh, self._slot_spec)
-        )
+        # streamed-population state (populated below when active)
+        self._population = None
+        self._population_val = None
+        self._cohort_data = None
+        self._cohort_val = None
+        self._cohort_prefetch = None
+        self._horizon_pos_rows = None
+
+        if self._population_streamed:
+            # the stacked client data stays HOST-resident (post-hoist, so
+            # fetched cohort rows are placement-ready); only the selected
+            # cohort is ever placed, via the double-buffered prefetcher
+            from ..util.population import CohortPrefetcher, PopulationStore
+
+            self._population = PopulationStore.from_stacked(self._data)
+            self._cohort_prefetch = CohortPrefetcher(self._fetch_cohort)
+            self._ckpt.register_finalizer(
+                "cohort_prefetch", self._cohort_prefetch.close
+            )
+        else:
+            self._data = put_sharded(
+                self._data, NamedSharding(self.mesh, self._slot_spec)
+            )
 
         # iid upload policy (reference ``enable_choose_model_by_validation``,
         # ``aggregation_worker.py:33-44``): clients upload their round's
@@ -880,10 +940,19 @@ class SpmdFedAvgSession(TraceCounterMixin):
                 config, dataset_collection, practitioners, self.n_slots
             )
             if val is not None:
-                self._val_data = put_sharded(
-                    self._hoist_batch_cast(val),
-                    NamedSharding(self.mesh, self._slot_spec),
-                )
+                val = self._hoist_batch_cast(val)
+                if self._population_streamed:
+                    # host-resident like the train stacks; the dispatch
+                    # routes the placed cohort's val rows instead of
+                    # ``self._val_data`` (left None so nothing full-size
+                    # ever reaches a program)
+                    from ..util.population import PopulationStore
+
+                    self._population_val = PopulationStore.from_stacked(val)
+                else:
+                    self._val_data = put_sharded(
+                        val, NamedSharding(self.mesh, self._slot_spec)
+                    )
 
         # per-client rng fold chain, device-resident end to end: the old
         # path materialized the folded keys on host (``np.asarray`` of the
@@ -993,6 +1062,24 @@ class SpmdFedAvgSession(TraceCounterMixin):
         return None
 
     @classmethod
+    def _class_population_store_reason(cls) -> str | None:
+        """Class-level ``population_store: streamed`` gate: the streamed
+        cohort path needs a round program that is shape-polymorphic in
+        the slot axis and takes its client stacks as explicit arguments —
+        the client-axis FedAvg family's program shape.  Whole-mesh
+        layouts (ep/sp/pp) scan clients inside ONE program with the
+        stacks closed over, so they defer to a follow-up and must reject
+        the knob loudly instead of silently keeping state resident."""
+        if cls is not SpmdFedAvgSession:
+            return (
+                "the streamed population store (population_store:"
+                " streamed) is implemented on the client-axis FedAvg"
+                f" family; {cls.__name__} keeps its per-client state"
+                " device-resident"
+            )
+        return None
+
+    @classmethod
     def capability_gates(cls) -> dict[str, str | None]:
         """The session class's static capability surface: fused-round
         knob -> rejection reason (None = supported at the class level;
@@ -1004,6 +1091,7 @@ class SpmdFedAvgSession(TraceCounterMixin):
             "selection_gather": cls._bespoke_round_program_reason(),
             "update_guard": cls._class_update_guard_reason(),
             "aggregation_mode": cls._class_buffered_reason(),
+            "population_store": cls._class_population_store_reason(),
         }
 
     def _selection_gather_unsupported_reason(self) -> str | None:
@@ -1033,6 +1121,24 @@ class SpmdFedAvgSession(TraceCounterMixin):
         into its round program (None = supported) — delegates to the
         class-level gate shared with the conf validator."""
         return self._class_update_guard_reason()
+
+    def _population_store_unsupported_reason(self) -> str | None:
+        """Why this session cannot stream its population (None =
+        supported): the class-level gate plus instance-state fallbacks
+        (FSDP partitions slots over BOTH mesh axes and all-gathers
+        population-shaped params — its slot layout is dense by
+        construction)."""
+        reason = self._class_population_store_reason()
+        if reason is not None:
+            return reason
+        if self._fsdp:
+            return (
+                "FSDP model sharding stores params in the dense slot"
+                " layout (all-gather/reduce_scatter are population-"
+                "shaped) — run streamed populations with"
+                " model_sharding: none"
+            )
+        return None
 
     def _buffered_unsupported_reason(self) -> str | None:
         """Why this session cannot run buffered-asynchronous aggregation
@@ -1772,15 +1878,26 @@ class SpmdFedAvgSession(TraceCounterMixin):
                         ),
                         sig_args=(weights, rngs, sel_idx),
                     )
+                # streamed populations ride the SAME dense-shaped program
+                # at cohort shape: the prefetcher placed the [s_pad] rows
+                # and _prepare_round_inputs stored them on the session —
+                # the program is shape-polymorphic in the slot axis, so
+                # the jit cache sees ONE stable signature (zero retraces)
+                if self._population_streamed:
+                    data, val = self._cohort_data, self._cohort_val
+                    label = "round[streamed]"
+                else:
+                    data, val = self._data, self._val_data
+                    label = "round[dense]"
                 return self._trace.dispatch(
-                    "round[dense]",
+                    label,
                     jitted,
                     (
                         global_params,
                         weights,
                         rngs,
-                        self._data,
-                        self._val_data or {},
+                        data,
+                        val or {},
                     ),
                     sig_args=(weights, rngs),
                 )
@@ -1867,9 +1984,15 @@ class SpmdFedAvgSession(TraceCounterMixin):
                         )
                     )
                 else:
+                    if self._population_streamed:
+                        data, val = self._cohort_data, self._cohort_val
+                        label = "round[buffered-streamed]"
+                    else:
+                        data, val = self._data, self._val_data
+                        label = "round[buffered]"
                     (new_global, self._pending), metrics = (
                         self._trace.dispatch(
-                            "round[buffered]",
+                            label,
                             jitted,
                             (
                                 global_params,
@@ -1877,8 +2000,8 @@ class SpmdFedAvgSession(TraceCounterMixin):
                                 weights,
                                 delays,
                                 rngs,
-                                self._data,
-                                self._val_data or {},
+                                data,
+                                val or {},
                             ),
                             sig_args=(weights, delays, rngs),
                         )
@@ -1924,6 +2047,8 @@ class SpmdFedAvgSession(TraceCounterMixin):
         in-program, runs the SAME round program the per-round path jits,
         and evaluates the fresh global on the device-resident test batches
         — stacked ``[H, ...]`` metrics come back in one host fetch."""
+        if self._population_streamed:
+            return self._build_streamed_horizon_fn(horizon)
         if self._buffered_active:
             return self._build_buffered_horizon_fn(horizon)
         engine = self.engine
@@ -2095,6 +2220,178 @@ class SpmdFedAvgSession(TraceCounterMixin):
                 )
             self._pending = pending
             return (global_params, rng), outs
+
+        fn._jitted = jitted
+        return fn
+
+    def _build_streamed_horizon_fn(self, horizon: int):
+        """The streamed-population twin of :meth:`_build_horizon_fn`: the
+        chunk's placed stack is the UNION of the horizon's ``[H, S_pad]``
+        selected ids (fetched once per chunk — the cohort-union rule),
+        and each scanned round takes its own rows by POSITION in that
+        union while folding per-client rngs by WORKER ID — positions
+        address the placed stack, ids pin the rng streams, so the
+        trajectory stays bit-identical to the resident path.  The union
+        is padded to the static ``H * S_pad`` so every chunk of the same
+        length shares one program shape (zero retraces).  Handles the
+        buffered pending-ring carry inline (same composition rule as the
+        resident builders)."""
+        engine = self.engine
+        round_program = self._round_program_fn
+        buffered_program = self._buffered_program_fn
+        buffered = self._buffered_active
+        with_confusion = bool(self.config.use_slow_performance_metrics)
+        cohort_sharding = NamedSharding(self.mesh, self._slot_spec)
+
+        def take(tree, pos):
+            return jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    jnp.take(x, pos, axis=0), cohort_sharding
+                ),
+                tree,
+            )
+
+        if buffered:
+
+            def horizon_program(
+                global_params,
+                pending,
+                rng,
+                weight_rows,
+                pos_rows,
+                id_rows,
+                delay_rows,
+                data,
+                val,
+                eval_batches,
+            ):
+                def body(carry, xs):
+                    params, pending, rng = carry
+                    weights, pos, ids, delays = xs
+                    rng, round_rng = jax.random.split(rng)
+                    client_rngs = jax.vmap(
+                        lambda i: jax.random.fold_in(round_rng, i)
+                    )(ids)
+                    (params, pending), train_metrics = buffered_program(
+                        params, pending, weights, delays, client_rngs,
+                        take(data, pos), take(val, pos) if val else {},
+                    )
+                    eval_summed = engine.eval_fn(params, eval_batches)
+                    outs = (train_metrics, eval_summed)
+                    if with_confusion:
+                        outs = outs + (
+                            engine.confusion_fn(params, eval_batches),
+                        )
+                    return (params, pending, rng), outs
+
+                (global_params, pending, rng), outs = jax.lax.scan(
+                    body,
+                    (global_params, pending, rng),
+                    (weight_rows, pos_rows, id_rows, delay_rows),
+                    length=horizon,
+                )
+                return (global_params, pending, rng), outs
+
+            jitted = jax.jit(
+                horizon_program,
+                donate_argnums=(0, 1, 2),
+                out_shardings=(
+                    (self._param_shardings, self._replicated, None),
+                    None,
+                ),
+            )
+
+            def fn(global_params, rng, weight_rows, idx_rows=None):
+                pending = self._ensure_pending()
+                delay_rows = self._horizon_delay_rows
+                pos_rows = self._horizon_pos_rows
+                with self._round_mesh_context():
+                    (global_params, pending, rng), outs = (
+                        self._trace.dispatch(
+                            f"horizon[buffered-streamed,h={horizon}]",
+                            jitted,
+                            (
+                                global_params,
+                                pending,
+                                rng,
+                                weight_rows,
+                                pos_rows,
+                                idx_rows,
+                                delay_rows,
+                                self._cohort_data,
+                                self._cohort_val or {},
+                                self._ensure_eval_batches(),
+                            ),
+                            sig_args=(
+                                weight_rows, pos_rows, idx_rows, delay_rows
+                            ),
+                        )
+                    )
+                self._pending = pending
+                return (global_params, rng), outs
+
+            fn._jitted = jitted
+            return fn
+
+        def horizon_program(
+            global_params,
+            rng,
+            weight_rows,
+            pos_rows,
+            id_rows,
+            data,
+            val,
+            eval_batches,
+        ):
+            def body(carry, xs):
+                params, rng = carry
+                weights, pos, ids = xs
+                rng, round_rng = jax.random.split(rng)
+                client_rngs = jax.vmap(
+                    lambda i: jax.random.fold_in(round_rng, i)
+                )(ids)
+                params, train_metrics = round_program(
+                    params, weights, client_rngs,
+                    take(data, pos), take(val, pos) if val else {},
+                )
+                eval_summed = engine.eval_fn(params, eval_batches)
+                outs = (train_metrics, eval_summed)
+                if with_confusion:
+                    outs = outs + (engine.confusion_fn(params, eval_batches),)
+                return (params, rng), outs
+
+            (global_params, rng), outs = jax.lax.scan(
+                body,
+                (global_params, rng),
+                (weight_rows, pos_rows, id_rows),
+                length=horizon,
+            )
+            return (global_params, rng), outs
+
+        jitted = jax.jit(
+            horizon_program,
+            donate_argnums=(0, 1),
+            out_shardings=((self._param_shardings, None), None),
+        )
+
+        def fn(global_params, rng, weight_rows, idx_rows=None):
+            pos_rows = self._horizon_pos_rows
+            with self._round_mesh_context():
+                return self._trace.dispatch(
+                    f"horizon[streamed,h={horizon}]",
+                    jitted,
+                    (
+                        global_params,
+                        rng,
+                        weight_rows,
+                        pos_rows,
+                        idx_rows,
+                        self._cohort_data,
+                        self._cohort_val or {},
+                        self._ensure_eval_batches(),
+                    ),
+                    sig_args=(weight_rows, pos_rows, idx_rows),
+                )
 
         fn._jitted = jitted
         return fn
@@ -2313,6 +2610,91 @@ class SpmdFedAvgSession(TraceCounterMixin):
         )
         return idx, weights, delays
 
+    # ---------------------------------------------- streamed populations
+    def _cohort_ids(self, round_number: int) -> np.ndarray:
+        """The round's ``[S_pad]`` cohort ids WITHOUT the fault/quorum
+        fold: the fault machinery zeroes/NaNs WEIGHTS but never changes
+        which ids occupy the row, so the prefetcher can compute round
+        r+1's cohort ahead of time without tripping r+1's quorum check a
+        round early.  (FedOBD overrides — its padding ids are distinct
+        unselected workers, not id 0.)"""
+        return self._base_index_rows(round_number)[0]
+
+    def _fetch_cohort(self, ids):
+        """Host rows → device for one cohort (the ``CohortPrefetcher``
+        fetch hook).  Runs on the prefetch thread: jax dispatch is
+        thread-safe, and nothing here touches the trace recorder."""
+        sharding = NamedSharding(self.mesh, self._slot_spec)
+        data = self._population.fetch(ids)
+        nbytes = sum(x.nbytes for x in jax.tree.leaves(data))
+        placed = put_sharded(data, sharding)
+        placed_val = None
+        if self._population_val is not None:
+            val = self._population_val.fetch(ids)
+            nbytes += sum(x.nbytes for x in jax.tree.leaves(val))
+            placed_val = put_sharded(val, sharding)
+        return (placed, placed_val), nbytes
+
+    def _take_cohort(self, round_number: int, ids: np.ndarray) -> None:
+        """Blockingly obtain the cohort placed for this round/chunk (the
+        double buffer usually already has it in flight — the wall the
+        session actually blocked is the ``exposed`` field of the
+        ``prefetch`` span, what the tracedump overlap gate bounds).  The
+        host-built id row is broadcast/asserted across processes first so
+        a pod never trains diverged cohorts (no-op single-process)."""
+        from .mesh import broadcast_selection_rows
+
+        ids = broadcast_selection_rows(np.asarray(ids))
+        (self._cohort_data, self._cohort_val), stats = (
+            self._cohort_prefetch.take(round_number, ids)
+        )
+        if self._trace.enabled:
+            fields = {
+                "round": int(round_number),
+                "exposed": round(stats.exposed, 6),
+                "bytes": int(stats.nbytes),
+            }
+            if not stats.prefetched:
+                # cold fetch (first round / resume): excluded from the
+                # overlap fraction — there was no prior round to hide it
+                # under
+                fields["warmup"] = True
+            self._trace.span_record("prefetch", stats.seconds, **fields)
+
+    def _schedule_next_cohort(self, round_number: int) -> None:
+        """Queue the NEXT round's cohort fetch+place so it overlaps the
+        current round's dispatched program (the double buffer)."""
+        if round_number > self.config.round:
+            return
+        self._cohort_prefetch.schedule(
+            round_number, self._cohort_ids(round_number)
+        )
+
+    def _streamed_horizon_ids(self, start_round: int, h: int):
+        """The fused chunk's cohort: per-round ``[h, S_pad]`` id rows,
+        their union padded to the static ``h * S_pad`` (one program shape
+        per horizon length), and the position rows mapping each round's
+        slots into the placed union stack."""
+        from ..util.population import union_cohort
+
+        id_rows = np.stack(
+            [
+                self._cohort_ids(r)
+                for r in range(start_round, start_round + h)
+            ]
+        )
+        ids_u, pos_rows = union_cohort(id_rows, h * self.s_pad)
+        return ids_u, pos_rows, id_rows
+
+    def _schedule_next_horizon_cohort(self, start_round: int) -> None:
+        """Queue the next chunk's union cohort behind this chunk's fused
+        scan."""
+        if start_round > self.config.round:
+            return
+        h = min(self.round_horizon, self.config.round - start_round + 1)
+        ids_u, _pos, _ids = self._streamed_horizon_ids(start_round, h)
+        self._cohort_prefetch.schedule(start_round, ids_u)
+
     def _prepare_round_inputs(self, round_number: int, round_rng):
         """Device inputs for ONE round program invocation:
         ``(host_weights, weights, client_rngs, sel_idx)`` — ``sel_idx`` is
@@ -2320,7 +2702,32 @@ class SpmdFedAvgSession(TraceCounterMixin):
         both exercise the session's actual selection path.  Under
         buffered replay the staleness-delay row rides session state
         (``_round_delays``) so every caller's dispatch surface stays
-        unchanged."""
+        unchanged.  Under streamed populations the placed cohort rides
+        ``_cohort_data``/``_cohort_val`` the same way, and the round's
+        rngs fold by WORKER ID (``_fold_sel_rngs``) — bit-identical to
+        the dense fold of the same ids."""
+        if self._population_streamed:
+            host_idx = self._cohort_ids(round_number)
+            if self._buffered_active:
+                _idx, host_weights, host_delays = (
+                    self._buffered_select_indices(round_number)
+                )
+            else:
+                _idx, host_weights = self._select_indices(round_number)
+                host_delays = None
+            self._take_cohort(round_number, host_idx)
+            self._schedule_next_cohort(round_number + 1)
+            sel_idx = put_sharded(host_idx, self._client_sharding)
+            weights = put_sharded(host_weights, self._client_sharding)
+            client_rngs = self._fold_sel_rngs(round_rng, sel_idx)
+            if host_delays is not None:
+                self._round_delays = put_sharded(
+                    host_delays, self._client_sharding
+                )
+            # sel_idx None: the dispatch runs the dense-shaped program at
+            # cohort shape over the placed rows — there is nothing left
+            # to gather
+            return host_weights, weights, client_rngs, None
         if self._buffered_active:
             if self._selection_gather:
                 host_idx, host_weights, host_delays = (
@@ -2359,7 +2766,40 @@ class SpmdFedAvgSession(TraceCounterMixin):
         the scanned inputs every horizon-fused session (FedAvg family AND
         the FedOBD phase programs) feeds its round scan.  Under buffered
         replay the ``[h, S]`` staleness-delay rows ride session state
-        (``_horizon_delay_rows``) next to the weight rows."""
+        (``_horizon_delay_rows``) next to the weight rows.  Under
+        streamed populations the chunk's UNION cohort is taken once here
+        (the cohort-union rule) with the position rows riding
+        ``_horizon_pos_rows``."""
+        if self._population_streamed:
+            if self._buffered_active:
+                triples = [
+                    self._buffered_select_indices(r)
+                    for r in range(start_round, start_round + h)
+                ]
+                host_weights = np.stack([w for _i, w, _d in triples])
+                host_delays = np.stack([d for _i, _w, d in triples])
+                self._horizon_delay_rows = put_sharded(
+                    host_delays, self._horizon_weight_sharding
+                )
+            else:
+                pairs = [
+                    self._select_indices(r)
+                    for r in range(start_round, start_round + h)
+                ]
+                host_weights = np.stack([w for _i, w in pairs])
+            ids_u, pos_rows, id_rows = self._streamed_horizon_ids(
+                start_round, h
+            )
+            self._take_cohort(start_round, ids_u)
+            self._schedule_next_horizon_cohort(start_round + h)
+            self._horizon_pos_rows = put_sharded(
+                pos_rows, self._horizon_weight_sharding
+            )
+            idx_rows = put_sharded(id_rows, self._horizon_weight_sharding)
+            weight_rows = put_sharded(
+                host_weights, self._horizon_weight_sharding
+            )
+            return host_weights, weight_rows, idx_rows
         if self._buffered_active:
             if self._selection_gather:
                 triples = [
@@ -2415,8 +2855,13 @@ class SpmdFedAvgSession(TraceCounterMixin):
         """Fraction of the round program's client-slot compute whose
         aggregation weight is zero (unselected slots + padding): the dense
         path trains ``n_slots`` for ``selected`` useful contributions, the
-        gather path trains ``s_pad``."""
-        trained = self.s_pad if self._selection_gather else self.n_slots
+        gather path trains ``s_pad``, and the streamed path only ever
+        PLACES (and trains) ``s_pad``."""
+        trained = (
+            self.s_pad
+            if (self._selection_gather or self._population_streamed)
+            else self.n_slots
+        )
         return 1.0 - self._selected_per_round / max(trained, 1)
 
     # ------------------------------------------------- shardcheck hooks
@@ -2469,6 +2914,167 @@ class SpmdFedAvgSession(TraceCounterMixin):
         params = attach_shardings(template, self._param_shardings)
         data = abstract_tree(self._data)
         val = abstract_tree(self._val_data or {})
+
+        if self._population_streamed:
+            # streamed populations dispatch the SAME dense-shaped jitted
+            # program at cohort shape: certify it against [s_pad]-leading
+            # abstract stacks carrying the slot sharding the prefetcher
+            # places them with
+            def cohort_abstract(tree, leading):
+                return jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(
+                        (leading, *np.shape(x)[1:]),
+                        np.asarray(x).dtype
+                        if not hasattr(x, "dtype")
+                        else x.dtype,
+                        sharding=self._client_sharding,
+                    ),
+                    tree,
+                )
+
+            cohort_data = cohort_abstract(self._data, self.s_pad)
+            cohort_val = (
+                cohort_abstract(
+                    self._population_val.fetch(np.zeros(1, np.int64)),
+                    self.s_pad,
+                )
+                if self._population_val is not None
+                else {}
+            )
+
+            def streamed_args(round_number):
+                if self._buffered_active:
+                    _i, weights, delays = self._buffered_select_indices(
+                        round_number
+                    )
+                    depth = self._buffered_depth
+                    pending = (
+                        {
+                            k: host_abstract(
+                                np.zeros((depth, *v.shape), np.float32),
+                                self._replicated,
+                            )
+                            for k, v in template.items()
+                        },
+                        host_abstract(
+                            np.zeros((depth,), np.float32),
+                            self._replicated,
+                        ),
+                    )
+                    return (
+                        params,
+                        pending,
+                        host_abstract(weights, self._client_sharding),
+                        host_abstract(delays, self._client_sharding),
+                        key_abstract(self._client_sharding, (self.s_pad,)),
+                        cohort_data,
+                        cohort_val,
+                    )
+                _i, weights = self._select_indices(round_number)
+                return (
+                    params,
+                    host_abstract(weights, self._client_sharding),
+                    key_abstract(self._client_sharding, (self.s_pad,)),
+                    cohort_data,
+                    cohort_val,
+                )
+
+            if self._buffered_active:
+                specs.append(
+                    ProgramSpec(
+                        name="round[buffered-streamed]",
+                        jitted=self._jitted_buffered_round_fn,
+                        args=streamed_args(1),
+                        alt_args=(streamed_args(2),),
+                        donate_argnums=(0, 1),
+                        mesh=self.mesh,
+                        out_pin=(
+                            (self._param_shardings, self._replicated),
+                            None,
+                        ),
+                        carries=(
+                            (0, lambda out: out[0][0]),
+                            (1, lambda out: out[0][1]),
+                        ),
+                        mesh_context=self._round_mesh_context,
+                    )
+                )
+            else:
+                specs.append(
+                    ProgramSpec(
+                        name="round[streamed]",
+                        jitted=self._jitted_round_fn,
+                        args=streamed_args(1),
+                        alt_args=(streamed_args(2),),
+                        donate_argnums=(0,),
+                        mesh=self.mesh,
+                        out_pin=self._round_out_shardings,
+                        carries=((0, lambda out: out[0]),),
+                        mesh_context=self._round_mesh_context,
+                    )
+                )
+            if self._horizon_capable() and not self._buffered_active:
+                h = max(2, min(self.round_horizon, 4))
+                fn = self._horizon_fns.get(h)
+                if fn is None:
+                    fn = self._horizon_fns[h] = self._build_horizon_fn(h)
+                eval_batches = abstract_tree(self._ensure_eval_batches())
+                union_pad = h * self.s_pad
+                union_data = cohort_abstract(self._data, union_pad)
+                union_val = (
+                    cohort_abstract(
+                        self._population_val.fetch(np.zeros(1, np.int64)),
+                        union_pad,
+                    )
+                    if self._population_val is not None
+                    else {}
+                )
+
+                def streamed_horizon_args(start_round):
+                    rows = [
+                        self._select_indices(r)
+                        for r in range(start_round, start_round + h)
+                    ]
+                    weight_rows = np.stack([w for _i, w in rows])
+                    _u, pos_rows, id_rows = self._streamed_horizon_ids(
+                        start_round, h
+                    )
+                    return (
+                        params,
+                        key_abstract(self._replicated),
+                        host_abstract(
+                            weight_rows, self._horizon_weight_sharding
+                        ),
+                        host_abstract(
+                            pos_rows, self._horizon_weight_sharding
+                        ),
+                        host_abstract(
+                            id_rows, self._horizon_weight_sharding
+                        ),
+                        union_data,
+                        union_val,
+                        eval_batches,
+                    )
+
+                specs.append(
+                    ProgramSpec(
+                        name=f"horizon[streamed,h={h}]",
+                        jitted=fn._jitted,
+                        args=streamed_horizon_args(1),
+                        alt_args=(streamed_horizon_args(1 + h),),
+                        donate_argnums=(0, 1),
+                        mesh=self.mesh,
+                        out_pin=((self._param_shardings, None), None),
+                        carries=(
+                            (0, lambda out: out[0][0]),
+                            (1, lambda out: out[0][1]),
+                        ),
+                        scanned_len=h,
+                        stacked_out=lambda out: out[1],
+                        mesh_context=self._round_mesh_context,
+                    )
+                )
+            return specs
 
         if self._buffered_active:
             # buffered replay: certify the dispatched per-round buffered
@@ -3196,9 +3802,31 @@ class SpmdSignSGDSession(TraceCounterMixin):
                 " worker_number) — nothing to skip; falling back to the"
                 " dense O(population) round path"
             )
+        # streamed populations, sign-SGD flavor: same knob and contract
+        # as SpmdFedAvgSession.  The per-round rng streams are HOST-built
+        # rows indexed by worker id on every path (``host_rngs[idx]``),
+        # so cohort-shaped programs are bit-exact by construction.
+        store_mode = (
+            str(
+                config.algorithm_kwargs.get("population_store", "device")
+                or "device"
+            )
+            .strip()
+            .lower()
+        )
+        if store_mode not in ("device", "streamed"):
+            raise ValueError(
+                "algorithm_kwargs.population_store must be 'device' or"
+                f" 'streamed', got {store_mode!r}"
+            )
+        self._population_streamed = store_mode == "streamed"
+        if self._population_streamed:
+            # the placed cohort IS the selection — the device-gather twin
+            # would gather from stacks that are no longer resident
+            self._selection_gather = False
         self.s_pad = (
             client_slots(self._selected_per_round, self.mesh)
-            if self._selection_gather
+            if (self._selection_gather or self._population_streamed)
             else self.n_slots
         )
         # fault tolerance: the availability mask rides the 0/1 vote-weight
@@ -3240,10 +3868,22 @@ class SpmdSignSGDSession(TraceCounterMixin):
         self._replicated = NamedSharding(self.mesh, P())
         # scan wants batch-major: [n_batches, C, B, ...]
 
-        self._data = put_sharded(
-            {k: np.swapaxes(v, 0, 1) for k, v in self._data.items()},
-            NamedSharding(self.mesh, P(None, "clients")),
-        )
+        self._population = None
+        self._cohort_data = None
+        self._cohort_prefetch = None
+        if self._population_streamed:
+            # the SLOT-major stacks stay host-resident in the population
+            # store; cohort rows are swapped to batch-major at placement
+            # (the prefetch thread's fetch hook)
+            from ..util.population import CohortPrefetcher, PopulationStore
+
+            self._population = PopulationStore.from_stacked(self._data)
+            self._cohort_prefetch = CohortPrefetcher(self._fetch_cohort)
+        else:
+            self._data = put_sharded(
+                {k: np.swapaxes(v, 0, 1) for k, v in self._data.items()},
+                NamedSharding(self.mesh, P(None, "clients")),
+            )
         self._run_program_fn = None
         self._horizon_fns: dict[int, object] = {}
         self._run_fn = self._build_run_fn()
@@ -3260,8 +3900,11 @@ class SpmdSignSGDSession(TraceCounterMixin):
         # (padding slots contribute count 0 anyway) so existing
         # trajectories stay bit-identical; under selection, unselected
         # clients must not leak into the recorded train curves (the
-        # gather path never trains them at all)
-        mask_metrics = self._per_round_weights
+        # gather path never trains them at all).  Streamed cohorts mask
+        # too: their padding rows DUPLICATE a real client's data (the
+        # id-0 padding contract) instead of holding the dense path's
+        # zero rows, so only the weight mask keeps the sums identical.
+        mask_metrics = self._per_round_weights or self._population_streamed
         guard_active = self._update_guard
 
         def shard_body(params, data, weights, rngs):
@@ -3361,7 +4004,12 @@ class SpmdSignSGDSession(TraceCounterMixin):
 
         self._gather_program_fn = None
         self._jitted_gather_run_fn = None
-        if self._selection_gather:
+        # the gather twin also backs the STREAMED horizon: its take() uses
+        # a fixed batch-major sharding constant (no trace-time read of the
+        # stored stacks' .sharding), so it is safe to build while the
+        # population lives on host — the horizon body gathers each round's
+        # cohort out of the placed union stack by POSITION rows
+        if self._selection_gather or self._population_streamed:
             batch_major_sharding = NamedSharding(self.mesh, P(None, "clients"))
 
             def gather_run_program(params, weights, rngs, sel_idx, data):
@@ -3391,6 +4039,16 @@ class SpmdSignSGDSession(TraceCounterMixin):
                     (params, weights, rngs, sel_idx, self._data),
                     sig_args=(weights, rngs, sel_idx),
                 )
+            if self._population_streamed:
+                # the SAME dense program, shape-specialized once at the
+                # cohort width: slots_local comes off the placed cohort,
+                # so every round hits one jit signature (zero retraces)
+                return self._trace.dispatch(
+                    "run[streamed]",
+                    jitted,
+                    (params, weights, rngs, self._cohort_data),
+                    sig_args=(weights, rngs),
+                )
             return self._trace.dispatch(
                 "run[dense]",
                 jitted,
@@ -3408,7 +4066,11 @@ class SpmdSignSGDSession(TraceCounterMixin):
         engine = self.engine
         run_program = self._run_program_fn
         gather_program = self._gather_program_fn
-        use_gather = self._selection_gather
+        # the streamed horizon rides the GATHER program shape: ``data`` is
+        # the placed union-of-cohorts stack and ``idx_rows`` are per-round
+        # POSITION rows into it (``union_cohort``); rng rows stay
+        # host-built by worker id, so trajectories match the dense path
+        use_gather = self._selection_gather or self._population_streamed
         per_round_weights = self._per_round_weights
         with_confusion = bool(self.config.use_slow_performance_metrics)
 
@@ -3448,6 +4110,20 @@ class SpmdSignSGDSession(TraceCounterMixin):
         jitted = jax.jit(horizon_program, donate_argnums=(0,))
 
         def fn(params, rng_rows, weights, eval_batches, idx_rows=None):
+            if self._population_streamed:
+                return self._trace.dispatch(
+                    f"horizon[streamed,h={horizon}]",
+                    jitted,
+                    (
+                        params,
+                        rng_rows,
+                        weights,
+                        idx_rows,
+                        self._cohort_data,
+                        eval_batches,
+                    ),
+                    sig_args=(rng_rows, idx_rows),
+                )
             return self._trace.dispatch(
                 f"horizon[h={horizon}]",
                 jitted,
@@ -3519,10 +4195,94 @@ class SpmdSignSGDSession(TraceCounterMixin):
         )
         return idx, weights
 
+    # ------------------------------------------- streamed-population path
+    def _cohort_ids(self, round_number: int) -> np.ndarray:
+        """The round's ``[s_pad]`` cohort ids WITHOUT the fault fold —
+        faults zero vote WEIGHTS, never which rows are fetched, so the
+        prefetcher can compute round r+1's ids ahead of time (see
+        :meth:`SpmdFedAvgSession._cohort_ids`).  ``select_workers``
+        returns every worker when selection is inactive, so full
+        participation streams too."""
+        from ..utils.selection import select_workers
+
+        selected = sorted(
+            select_workers(
+                self.config.seed,
+                round_number,
+                self.config.worker_number,
+                self.config.algorithm_kwargs.get("random_client_number"),
+            )
+        )
+        idx = np.zeros(self.s_pad, np.int32)
+        idx[: len(selected)] = selected
+        return idx
+
+    def _fetch_cohort(self, ids):
+        """Prefetch-thread hook: host slot-major rows → batch-major device
+        placement (the swap the dense path did once at init now happens
+        per cohort, on the prefetch thread, off the round's critical
+        path)."""
+        host = self._population.fetch(ids)
+        nbytes = sum(x.nbytes for x in jax.tree.leaves(host))
+        placed = put_sharded(
+            {k: np.swapaxes(v, 0, 1) for k, v in host.items()},
+            NamedSharding(self.mesh, P(None, "clients")),
+        )
+        return placed, nbytes
+
+    def _take_cohort(self, round_number: int, ids: np.ndarray) -> None:
+        """See :meth:`SpmdFedAvgSession._take_cohort` — broadcast/assert
+        the host-built ids across processes, block on the double buffer,
+        record the ``prefetch`` span with its exposed wall."""
+        from .mesh import broadcast_selection_rows
+
+        ids = broadcast_selection_rows(np.asarray(ids))
+        self._cohort_data, stats = self._cohort_prefetch.take(
+            round_number, ids
+        )
+        if self._trace.enabled:
+            fields = {
+                "round": int(round_number),
+                "exposed": round(stats.exposed, 6),
+                "bytes": int(stats.nbytes),
+            }
+            if not stats.prefetched:
+                fields["warmup"] = True
+            self._trace.span_record("prefetch", stats.seconds, **fields)
+
+    def _schedule_next_cohort(self, round_number: int) -> None:
+        if round_number > self.config.round:
+            return
+        self._cohort_prefetch.schedule(
+            round_number, self._cohort_ids(round_number)
+        )
+
+    def _schedule_next_horizon_cohort(self, start_round: int) -> None:
+        """Queue the next fused chunk's union-of-cohorts fetch behind the
+        current chunk's scan (same union rule as the take site, so the
+        prefetched ids always match)."""
+        if start_round > self.config.round:
+            return
+        from ..util.population import union_cohort
+
+        h = min(self.round_horizon, self.config.round - start_round + 1)
+        id_rows = np.stack(
+            [
+                self._cohort_ids(r)
+                for r in range(start_round, start_round + h)
+            ]
+        )
+        ids_u, _pos = union_cohort(id_rows, h * self.s_pad)
+        self._cohort_prefetch.schedule(start_round, ids_u)
+
     @property
     def wasted_compute_fraction(self) -> float:
         """See :meth:`SpmdFedAvgSession.wasted_compute_fraction`."""
-        trained = self.s_pad if self._selection_gather else self.n_slots
+        trained = (
+            self.s_pad
+            if (self._selection_gather or self._population_streamed)
+            else self.n_slots
+        )
         return 1.0 - self._selected_per_round / max(trained, 1)
 
     # ------------------------------------------------- shardcheck hooks
@@ -3536,6 +4296,7 @@ class SpmdSignSGDSession(TraceCounterMixin):
             "selection_gather": None,
             "update_guard": None,
             "aggregation_mode": cls._class_buffered_reason(),
+            "population_store": None,
         }
 
     @classmethod
@@ -3582,13 +4343,39 @@ class SpmdSignSGDSession(TraceCounterMixin):
             ),
             template,
         )
-        data = abstract_tree(self._data)
+        if self._population_streamed:
+            # streamed: the stored stacks are HOST slot-major
+            # [n_slots, n_batches, ...] numpy — the programs see
+            # batch-major cohort-shaped placements instead
+            batch_major = NamedSharding(self.mesh, P(None, "clients"))
+
+            def cohort_abstract(leading):
+                return {
+                    k: jax.ShapeDtypeStruct(
+                        (v.shape[1], leading) + tuple(v.shape[2:]),
+                        v.dtype,
+                        sharding=batch_major,
+                    )
+                    for k, v in self._data.items()
+                }
+
+            data = None
+        else:
+            data = abstract_tree(self._data)
         dense_weights = host_abstract(
             (self._dataset_sizes > 0).astype(np.float32),
             self._client_sharding,
         )
 
         def run_args(round_number):
+            if self._population_streamed:
+                _idx, weights = self._select_indices(round_number)
+                return (
+                    params,
+                    host_abstract(weights, self._client_sharding),
+                    key_abstract(self._client_sharding, (self.s_pad,)),
+                    cohort_abstract(self.s_pad),
+                )
             if self._selection_gather:
                 idx, weights = self._select_indices(round_number)
                 return (
@@ -3615,7 +4402,9 @@ class SpmdSignSGDSession(TraceCounterMixin):
         specs = [
             ProgramSpec(
                 name=(
-                    "run[gather]"
+                    "run[streamed]"
+                    if self._population_streamed
+                    else "run[gather]"
                     if self._selection_gather
                     else "run[dense]"
                 ),
@@ -3648,6 +4437,23 @@ class SpmdSignSGDSession(TraceCounterMixin):
 
         def horizon_args(start_round):
             rounds = range(start_round, start_round + h)
+            if self._population_streamed:
+                from ..util.population import union_cohort
+
+                pairs = [self._select_indices(r) for r in rounds]
+                _ids_u, pos_rows = union_cohort(
+                    np.stack([i for i, _w in pairs]), h * self.s_pad
+                )
+                return (
+                    params,
+                    key_abstract(rng_sharding, (h, self.s_pad)),
+                    host_abstract(
+                        np.stack([w for _i, w in pairs]), rng_sharding
+                    ),
+                    host_abstract(pos_rows, rng_sharding),
+                    cohort_abstract(h * self.s_pad),
+                    eval_batches,
+                )
             if self._selection_gather:
                 pairs = [self._select_indices(r) for r in rounds]
                 idx_rows = host_abstract(
@@ -3679,7 +4485,11 @@ class SpmdSignSGDSession(TraceCounterMixin):
 
         specs.append(
             ProgramSpec(
-                name=f"horizon[h={h}]",
+                name=(
+                    f"horizon[streamed,h={h}]"
+                    if self._population_streamed
+                    else f"horizon[h={h}]"
+                ),
                 jitted=fn._jitted,
                 args=horizon_args(1),
                 alt_args=(horizon_args(1 + h),),
@@ -3780,7 +4590,17 @@ class SpmdSignSGDSession(TraceCounterMixin):
                     jax.random.PRNGKey(config.seed + round_number), self.n_slots
                 )
             )
-            if self._selection_gather:
+            if self._population_streamed:
+                # the placed cohort IS the selection: dense program at the
+                # cohort width, rngs/weights the selected rows of the same
+                # host-built tables the dense path would use (bit-exact)
+                host_idx, host_w = self._select_indices(round_number)
+                self._take_cohort(round_number, host_idx)
+                self._schedule_next_cohort(round_number + 1)
+                sel_idx = None
+                round_weights = put_sharded(host_w, self._client_sharding)
+                rngs = put_sharded(host_rngs[host_idx], self._client_sharding)
+            elif self._selection_gather:
                 host_idx, host_w = self._select_indices(round_number)
                 sel_idx = put_sharded(host_idx, self._client_sharding)
                 round_weights = put_sharded(host_w, self._client_sharding)
@@ -3848,6 +4668,8 @@ class SpmdSignSGDSession(TraceCounterMixin):
             # lands so the chaos suite can observe completed rounds
             if self._fault_plan is not None:
                 self._fault_plan.maybe_kill(round_number)
+        if self._cohort_prefetch is not None:
+            self._cohort_prefetch.close()
         self._trace.close()
         return {"performance": self._stat}
 
@@ -3888,7 +4710,26 @@ class SpmdSignSGDSession(TraceCounterMixin):
             ]
             idx_rows = None
             weight_arg = weights
-            if self._selection_gather:
+            if self._population_streamed:
+                # union-of-cohorts chunk: one fetch+place per h rounds,
+                # per-round POSITION rows gather each round's slots out
+                # of the placed union (the cohort-union rule); rngs are
+                # the worker-ID rows of the same host splits as dense
+                from ..util.population import union_cohort
+
+                pairs = [self._select_indices(r) for r in rounds]
+                id_rows = np.stack([i for i, _w in pairs])
+                ids_u, pos_rows = union_cohort(id_rows, h * self.s_pad)
+                self._take_cohort(round_number, ids_u)
+                self._schedule_next_horizon_cohort(round_number + h)
+                host_rng_rows = [
+                    row[idx] for row, (idx, _w) in zip(host_rng_rows, pairs)
+                ]
+                idx_rows = put_sharded(pos_rows, rng_sharding)
+                weight_arg = put_sharded(
+                    np.stack([w for _i, w in pairs]), rng_sharding
+                )
+            elif self._selection_gather:
                 pairs = [self._select_indices(r) for r in rounds]
                 host_rng_rows = [
                     row[idx] for row, (idx, _w) in zip(host_rng_rows, pairs)
@@ -3957,6 +4798,8 @@ class SpmdSignSGDSession(TraceCounterMixin):
                 for r in range(round_number, boundary + 1):
                     self._fault_plan.maybe_kill(r)
             round_number += h
+        if self._cohort_prefetch is not None:
+            self._cohort_prefetch.close()
         self._trace.close()
         return {"performance": self._stat}
 

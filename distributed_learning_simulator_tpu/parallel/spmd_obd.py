@@ -112,7 +112,11 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         # it to the default-mesh count AFTER this __init__ returns, and
         # capturing the client-axis value would silently diverge their
         # gather stream from the dense path's.
-        if self._selection_gather:
+        if self._selection_gather or self._population_streamed:
+            # the streamed path derives its cohort keys the same way the
+            # gather path does: the selected rows of the full-population
+            # split, taken by WORKER ID — bit-identical to the dense
+            # slice of the same split
             session = self
             self._split_sel_rngs = jax.jit(
                 lambda round_rng, sel_idx: jnp.take(
@@ -121,6 +125,28 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                     axis=0,
                 ),
                 out_shardings=self._client_sharding,
+            )
+        # streamed populations, OBD flavor: alongside the host-resident
+        # client data (base __init__), the per-slot OPTIMIZER states live
+        # in a SPARSE host store whose default row is one slot's fresh
+        # optimizer init — "never written" IS the fresh-init contract, so
+        # never-selected clients keep fresh state without materializing
+        # the population.  Each phase-1 round fetches only the cohort's
+        # opt rows, the program participation-merges them (weight-0
+        # padding keeps its old rows), and the updated rows write back
+        # asynchronously behind the next round's prefetch.
+        self._opt_population = None
+        self._writeback = None
+        self._phase2_streamed_ready = False
+        if self._population_streamed:
+            from ..util.population import PopulationStore, WritebackQueue
+
+            self._opt_population = PopulationStore.lazy(
+                self._fresh_opt_row, self.n_slots
+            )
+            self._writeback = WritebackQueue(self._opt_population)
+            self._ckpt.register_finalizer(
+                "opt_writeback", self._writeback.close
             )
 
     @property
@@ -140,7 +166,13 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         slot's phase-2 seed is the state from its last participation and
         the dense/gather paths agree on it bit-exactly.  Full
         participation keeps the legacy carry-less semantics (every slot
-        trains every round; the last round's states seed phase 2)."""
+        trains every round; the last round's states seed phase 2).
+        Streamed populations ALWAYS carry: the cohort's fetched opt rows
+        enter every phase-1 program and the merged rows write back to
+        the host store — the store row is each slot's last-participation
+        state by construction."""
+        if getattr(self, "_population_streamed", False):
+            return True
         return self._obd_selection_active and (
             type(self) is SpmdFedOBDSession or self._whole_mesh_fused
         )
@@ -171,6 +203,38 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
 
     def _selection_gather_unsupported_reason(self) -> str | None:
         return self._bespoke_round_program_reason()
+
+    @classmethod
+    def _class_population_store_reason(cls) -> str | None:
+        """The client-axis OBD session streams: its phase programs are
+        shape-polymorphic in the slot axis and take the client stacks
+        (and the per-slot opt carry) as explicit arguments.  The
+        whole-mesh ep/sp layouts scan clients inside one program with
+        the stacks closed over — they defer to a follow-up."""
+        if cls is SpmdFedOBDSession:
+            return None
+        return (
+            f"{cls.__name__} scans clients inside one whole-mesh program"
+            " with the stacked client state closed over — streamed"
+            " populations defer to a follow-up there"
+        )
+
+    def _population_store_unsupported_reason(self) -> str | None:
+        reason = super()._population_store_unsupported_reason()
+        if reason is not None:
+            return reason
+        horizon = int(
+            self.config.algorithm_kwargs.get("round_horizon", 1) or 1
+        )
+        if horizon > 1:
+            return (
+                "the streamed OBD path fetches each round's cohort opt"
+                " rows and writes the merged rows back between"
+                " dispatches; round fusion (round_horizon > 1) would"
+                " trap that writeback inside one program — run streamed"
+                " fed_obd with round_horizon=1"
+            )
+        return None
 
     def _horizon_capable(self) -> bool:
         return self._bespoke_round_program_reason() is None
@@ -228,6 +292,83 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             self.config.worker_number,
         )
         return idx, weights
+
+    # ------------------------------------------- streamed-population path
+    def _cohort_ids(self, round_number: int) -> np.ndarray:
+        """The round's cohort ids WITHOUT the fault/quorum fold (see the
+        base class) — the OBD id construction: selected workers padded
+        with DISTINCT unselected ids (``_select_indices``' scatter-safety
+        contract doubles as the writeback's last-writer-wins one)."""
+        from ..utils.selection import select_workers
+
+        selected = sorted(
+            select_workers(
+                self.config.seed,
+                round_number,
+                self.config.worker_number,
+                self.config.algorithm_kwargs.get("random_client_number"),
+            )
+        )
+        taken = set(selected)
+        padding = [i for i in range(self.n_slots) if i not in taken]
+        return np.asarray(
+            selected + padding[: self.s_pad - len(selected)], np.int32
+        )
+
+    def _fresh_opt_row(self):
+        """ONE slot's fresh optimizer state as host numpy — the sparse
+        opt store's default row.  Init from the compute-dtype view so
+        the rows byte-match the in-program ``optimizer.init`` over the
+        residency cast (``_opt_state_template``)."""
+        cdtype = self._resident_dtype
+        params = self.engine.init_params(self.config.seed)
+        row = self.engine.optimizer.init(
+            params if cdtype is None else tree_cast(params, cdtype)
+        )
+        return jax.tree.map(np.asarray, row)
+
+    def _take_cohort_opt(self, ids: np.ndarray):
+        """Place the cohort's per-slot optimizer rows for this round.
+        Pending writebacks drain first so round r-1's merged rows are
+        visible (last-writer-wins store).  The phase programs DONATE
+        this buffer, and ``device_put`` of aligned host numpy ALIASES
+        the python-owned storage — ``jnp.copy`` gives XLA-owned buffers
+        the donation can legally consume."""
+        self._writeback.drain()
+        rows = self._opt_population.fetch(ids)
+        placed = put_sharded(rows, self._client_sharding)
+        return jax.tree.map(jnp.copy, placed)
+
+    def _materialize_streamed_phase2(self):
+        """Phase 2 trains EVERY client each epoch — there is no cohort
+        to stream.  At the switch the full population materializes on
+        device once: the stacked data through the prefetcher's fetch
+        hook and the opt buffer merged from each slot's last
+        participation (fresh init if never selected).  Documented
+        limitation: streamed fed_obd's phase 2 needs the population
+        resident (the reference workload is 100 clients; the
+        million-client streaming target is the single-phase fed_avg
+        family)."""
+        self._writeback.drain()
+        all_ids = np.arange(self.n_slots, dtype=np.int64)
+        (self._cohort_data, self._cohort_val), _nbytes = self._fetch_cohort(
+            all_ids
+        )
+        rows = self._opt_population.fetch(all_ids)
+        placed = put_sharded(rows, self._client_sharding)
+        self._phase2_streamed_ready = True
+        return jax.tree.map(jnp.copy, placed)
+
+    def _drain_writeback_spans(self) -> None:
+        """Emit ``writeback`` spans for completed async writebacks —
+        from the SESSION thread (the worker only collects timings; the
+        trace recorder is never touched off-thread)."""
+        if self._writeback is None:
+            return
+        for job in self._writeback.pop_completed():
+            seconds = job.pop("seconds", 0.0)
+            if self._trace.enabled:
+                self._trace.span_record("writeback", seconds, **job)
 
     # ------------------------------------------------------------------
     def _build_round_fn(self):
@@ -653,6 +794,19 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                         ),
                         sig_args=(weights, rngs, sel_idx),
                     )
+                if self._population_streamed:
+                    # phase 1: the placed cohort; phase 2: the full
+                    # population, materialized once at the switch — both
+                    # ride _cohort_data so the dispatch surface is one
+                    return self._trace.dispatch(
+                        f"{phase_name}[streamed]",
+                        jitted,
+                        (
+                            global_params, opt_state_s, weights, rngs,
+                            bcast_rng, self._cohort_data,
+                        ),
+                        sig_args=(weights, rngs),
+                    )
                 return self._trace.dispatch(
                     f"{phase_name}[dense]",
                     jitted,
@@ -848,6 +1002,84 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                 pairs = pairs + ((1, lambda out: out[2]),)
             return pairs
 
+        if self._population_streamed:
+            # under streamed the stored stacks are HOST numpy; the
+            # programs see cohort-shaped placements (phase 1 at s_pad,
+            # phase 2 at the materialized full population) — and the
+            # phase-1 opt rows are fetched fresh per round, not carried
+            from jax.sharding import NamedSharding
+
+            cohort_sharding = NamedSharding(self.mesh, self._slot_spec)
+
+            def cohort_data_abstract(leading):
+                return {
+                    k: jax.ShapeDtypeStruct(
+                        (leading,) + v.shape[1:], v.dtype,
+                        sharding=cohort_sharding,
+                    )
+                    for k, v in self._data.items()
+                }
+
+            def cohort_opt_abstract(leading):
+                return jax.tree.map(
+                    lambda s: jax.ShapeDtypeStruct(
+                        (leading,) + s.shape[1:], s.dtype,
+                        sharding=self._client_sharding,
+                    ),
+                    self._opt_state_template(),
+                )
+
+            def streamed_p1_args(round_number):
+                _idx, weights = self._select_indices(round_number)
+                return (
+                    params,
+                    cohort_opt_abstract(self.s_pad),
+                    host_abstract(weights, self._client_sharding),
+                    key_abstract(self._client_sharding, (self.s_pad,)),
+                    bcast_rng,
+                    cohort_data_abstract(self.s_pad),
+                )
+
+            specs.append(
+                ProgramSpec(
+                    name="phase1[streamed]",
+                    jitted=self._phase1_fn._jitted,
+                    args=streamed_p1_args(1),
+                    alt_args=(streamed_p1_args(2),),
+                    donate_argnums=(0, 1),
+                    mesh=self.mesh,
+                    out_pin=self._phase_out_shardings.get(False),
+                    carries=carries(False),
+                    mesh_context=self._round_mesh_context,
+                )
+            )
+            phase2_weights = self._dataset_sizes.astype(np.float32)
+
+            def streamed_p2_args(_round_number):
+                return (
+                    params,
+                    cohort_opt_abstract(self.n_slots),
+                    host_abstract(phase2_weights, self._client_sharding),
+                    key_abstract(self._client_sharding, (self.n_slots,)),
+                    bcast_rng,
+                    cohort_data_abstract(self.n_slots),
+                )
+
+            specs.append(
+                ProgramSpec(
+                    name="phase2[streamed]",
+                    jitted=self._phase2_fn._jitted,
+                    args=streamed_p2_args(1),
+                    alt_args=(streamed_p2_args(2),),
+                    donate_argnums=(0, 1),
+                    mesh=self.mesh,
+                    out_pin=self._phase_out_shardings.get(True),
+                    carries=carries(True),
+                    mesh_context=self._round_mesh_context,
+                )
+            )
+            return specs
+
         p1_opt = self._phase1_carries_opt
         if self._selection_gather:
             specs.append(
@@ -990,6 +1222,30 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
         aggregate they belong to — phase-2 resume then continues momentum
         and schedule position exactly (the SURVEY §5 TPU plan's
         'per-client opt state' checkpoint)."""
+        if self._population_streamed:
+            # streamed: the durable form IS the host store (npz chunks +
+            # manifest, tagged with the aggregate key — the torn-store
+            # fallback rides util/population's resume contract).  In
+            # phase 2 the carry lives on device; sync it back first.
+            self._writeback.drain()
+            if self._phase2_streamed_ready and self._opt_state_s is not None:
+                state = self._opt_state_s
+                if jax.process_count() > 1:
+                    state = jax.tree.map(
+                        lambda leaf: jax.device_put(leaf, self._replicated),
+                        state,
+                    )
+                self._opt_population.writeback(
+                    np.arange(self.n_slots), jax.device_get(state)
+                )
+            self._opt_population.save(
+                os.path.join(
+                    self.config.save_dir, "aggregated_model",
+                    "opt_population",
+                ),
+                tag=int(stat_key),
+            )
+            return
         leaves = jax.tree.leaves(self._opt_state_s)
         if jax.process_count() > 1:
             # the [S, ...] states are client-sharded across hosts; the
@@ -1005,7 +1261,40 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
 
     def _load_opt_state(self, resume_dir: str, expect_key: int):
         """The saved optimizer states, or None when absent / from a
-        different aggregate than the resume point."""
+        different aggregate than the resume point.  Streamed: adopts the
+        restored HOST store in place (and returns None — there is no
+        device buffer to hand the run loop); a torn/mismatched store
+        falls back to fresh per-slot state with a warning."""
+        if self._population_streamed:
+            from ..util.population import PopulationStore, WritebackQueue
+
+            store = PopulationStore.load(
+                os.path.join(
+                    resume_dir, "aggregated_model", "opt_population"
+                ),
+                default_row=self._fresh_opt_row,
+                expect_tag=int(expect_key),
+            )
+            if store is None:
+                get_logger().warning(
+                    "no matching streamed opt-state store under %s —"
+                    " resuming with fresh per-slot optimizers",
+                    resume_dir,
+                )
+                return None
+            self._writeback.close()
+            self._opt_population = store
+            self._writeback = WritebackQueue(store)
+            self._ckpt.register_finalizer(
+                "opt_writeback", self._writeback.close
+            )
+            get_logger().info(
+                "restored streamed per-slot opt store (aggregate %d, %d"
+                " materialized rows)",
+                expect_key,
+                len(store.materialized_ids()),
+            )
+            return None
         path = os.path.join(resume_dir, "aggregated_model", "opt_state.npz")
         if not os.path.isfile(path):
             return None
@@ -1202,12 +1491,23 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
             )(train_params)
 
         def step(fn, params, weights, round_number, phase_label, use_opt,
-                 sel_host=None):
+                 sel_host=None, stream_ids=None):
             nonlocal rng, opt_state_s
             rng, round_rng, bcast_rng = jax.random.split(rng, 3)
             if sel_host is not None:
                 sel_idx = put_sharded(sel_host, self._client_sharding)
                 client_rngs = self._split_sel_rngs(round_rng, sel_idx)
+            elif stream_ids is not None:
+                # streamed phase 1: dense-shaped program at the cohort
+                # width — keys are the cohort's WORKER-ID rows of the
+                # same full-population split (bit-exact vs dense/gather)
+                sel_idx = None
+                client_rngs = self._split_sel_rngs(
+                    round_rng,
+                    put_sharded(
+                        np.asarray(stream_ids), self._client_sharding
+                    ),
+                )
             else:
                 sel_idx = None
                 # split to the shared stream count, slots at the leading
@@ -1267,7 +1567,11 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                     if fused
                     else 1
                 )
-                if (carry_opt or h > 1) and opt_state_s is None:
+                if (
+                    (carry_opt or h > 1)
+                    and opt_state_s is None
+                    and not self._population_streamed
+                ):
                     # fresh per-slot optimizers: phase 2 with no phase-1
                     # rounds before it, the first carrying phase-1 round
                     # (never-selected slots keep these init states as
@@ -1288,20 +1592,49 @@ class SpmdFedOBDSession(SpmdFedAvgSession):
                     key = keys[0]
                     round_start = _time.monotonic()
                     sel_host = None
+                    stream_ids = None
                     if phase_two:
                         fn = self._phase2_fn
                         weights = self._phase2_weights(key)
+                        if (
+                            self._population_streamed
+                            and not self._phase2_streamed_ready
+                        ):
+                            opt_state_s = self._materialize_streamed_phase2()
                     else:
                         fn = self._phase1_fn
                         if self._selection_gather:
                             sel_host, weights = self._select_indices(key)
+                        elif self._population_streamed:
+                            stream_ids = self._cohort_ids(key)
+                            _idx, weights = self._select_indices(key)
+                            self._take_cohort(key, stream_ids)
+                            self._schedule_next_cohort(key + 1)
+                            opt_state_s = self._take_cohort_opt(stream_ids)
                         else:
                             weights = self._select_weights(key)
                     participating = int((weights != 0).sum())
                     exact, train_params, met = step(
                         fn, train_params, weights, key, phase_label,
                         use_opt=carry_opt, sel_host=sel_host,
+                        stream_ids=stream_ids,
                     )
+                    if stream_ids is not None:
+                        # the merged cohort rows drain back to the host
+                        # store behind the next round's prefetch; the
+                        # weight-0 padding rows write their own old
+                        # values (a per-slot no-op)
+                        self._writeback.submit(
+                            stream_ids,
+                            self._opt_state_s,
+                            round=key,
+                            bytes=int(
+                                self._opt_population.row_nbytes
+                                * len(stream_ids)
+                            ),
+                        )
+                        opt_state_s = None
+                        self._drain_writeback_spans()
                     with self._trace.span("eval", round=key):
                         metric = self._watchdog.call(
                             lambda: self._evaluate(exact),

@@ -881,8 +881,25 @@ def _make_spmd_session(ctx: TaskContext):
     # (clients=devices/M, model=M) — on fed_avg this turns on FSDP param
     # sharding over the model axis (parallel/spmd.py)
     model_parallel = int(ctx.config.algorithm_kwargs.get("model_parallel", 1))
+    # ``algorithm_kwargs.hybrid_mesh_hosts`` opts into the (hosts × chips)
+    # hybrid layout: the ``clients`` axis spans hosts so streamed cohort
+    # rows land on their owning host's chips without crossing DCN.  A
+    # positive int carves virtual per-host blocks (the forced-host-device
+    # CI harness); ``auto`` groups by real process_index on a pod.
+    hybrid_hosts = ctx.config.algorithm_kwargs.get("hybrid_mesh_hosts")
     session_kwargs = {}
-    if model_parallel > 1:
+    if hybrid_hosts is not None:
+        from .parallel.mesh import create_hybrid_device_mesh
+
+        session_kwargs["mesh"] = create_hybrid_device_mesh(
+            model_parallel=model_parallel,
+            virtual_hosts=(
+                None
+                if str(hybrid_hosts).strip().lower() == "auto"
+                else int(hybrid_hosts)
+            ),
+        )
+    elif model_parallel > 1:
         from .parallel.mesh import make_mesh
 
         session_kwargs["mesh"] = make_mesh(model_parallel=model_parallel)

@@ -39,13 +39,20 @@ def calibration_key(
     n_slots: int,
     s_pad: int,
     batch_size: int,
+    population_store: str = "device",
 ) -> str:
     """The canonical cache key — autotune's writer and the session's
-    reader MUST build it through this one function."""
+    reader MUST build it through this one function.
+
+    ``population_store`` is part of the key: the streamed layout runs
+    cohort-shaped programs whose chunking trade-off (HBM headroom,
+    transfer/compute overlap) differs from the device-resident layout,
+    so a calibration taken on one must NEVER silently hit on the other
+    — a mismatch is a loud miss, pinned by tests."""
     mesh = ",".join(f"{k}={v}" for k, v in sorted((mesh_shape or {}).items()))
     return (
         f"{session}|{model_name}|mesh[{mesh}]|slots={n_slots}"
-        f"|s_pad={s_pad}|batch={batch_size}"
+        f"|s_pad={s_pad}|batch={batch_size}|pop={population_store}"
     )
 
 
@@ -53,6 +60,7 @@ def session_calibration_key(session_obj) -> str:
     """Key for a live session object (reader side)."""
     mesh = getattr(session_obj, "mesh", None)
     mesh_shape = dict(mesh.shape) if mesh is not None else {}
+    streamed = bool(getattr(session_obj, "_population_streamed", False))
     return calibration_key(
         session=type(session_obj).__name__,
         model_name=getattr(session_obj.config, "model_name", ""),
@@ -60,6 +68,7 @@ def session_calibration_key(session_obj) -> str:
         n_slots=int(getattr(session_obj, "n_slots", 0)),
         s_pad=int(getattr(session_obj, "s_pad", 0)),
         batch_size=int(getattr(session_obj.config, "batch_size", 0)),
+        population_store="streamed" if streamed else "device",
     )
 
 

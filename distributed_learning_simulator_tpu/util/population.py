@@ -1,0 +1,458 @@
+"""Streamed populations: host-offloaded per-client state.
+
+``algorithm_kwargs.population_store: streamed`` moves the full
+population's per-client state (stacked client data, and the OBD
+sessions' per-slot optimizer states) out of HBM into this host-side
+store; each round only the selected ``[S_pad]`` cohort (the union of
+the horizon's cohorts under round fusion) is placed on device.
+Selection gather (PR 3) made round COMPUTE scale with participants —
+this makes round MEMORY scale with participants too, the
+resident-cohort/streamed-population split production FL systems use to
+reach million-client populations (Bonawitz et al.; PAPER.md).
+
+Three pieces:
+
+* :class:`PopulationStore` — slot-major host store (dense numpy leaves
+  or a sparse row dict with a lazy default row, so never-selected
+  clients keep their fresh-init state without materializing the whole
+  population), with npz-backed chunked persistence: atomic tmp+rename
+  chunk writes, a manifest, and the ``util/resume.py`` torn-store
+  contract (an unreadable/torn chunk set loads as None with a warning —
+  the caller falls back to fresh state instead of crashing).
+* :class:`CohortPrefetcher` — double-buffered background fetch +
+  device placement: round ``r+1``'s cohort transfer overlaps round
+  ``r``'s dispatched program; ``take`` reports how long the host
+  actually BLOCKED (the exposed wall the roundtrace ``prefetch`` spans
+  carry — test.sh gates ``prefetch_exposed_fraction``).
+* :class:`WritebackQueue` — asynchronous device→host writeback of an
+  updated cohort's rows, draining behind the next round's prefetch;
+  completed-job timings are collected by the session thread for
+  ``writeback`` spans (the recorder is not touched off-thread).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+STORE_VERSION = 1
+_MANIFEST = "population_manifest.json"
+
+
+def _tree_flatten(tree) -> tuple[list, object]:
+    import jax
+
+    return jax.tree.flatten(tree)
+
+
+def _tree_unflatten(treedef, leaves):
+    import jax
+
+    return jax.tree.unflatten(treedef, leaves)
+
+
+class PopulationStore:
+    """Slot-major per-client state: a pytree whose leaves carry a
+    leading ``[n_slots]`` axis, resident in host RAM.
+
+    Dense mode (:meth:`from_stacked`) wraps an already-stacked tree —
+    the read-mostly client-data store.  Sparse mode (:meth:`lazy`)
+    materializes rows on first touch from a ``default_row`` factory —
+    the mutable opt-state store, where "never written" IS the fresh-init
+    contract."""
+
+    def __init__(self, *, n_slots: int, leaves, treedef, default_row=None):
+        self.n_slots = int(n_slots)
+        self._treedef = treedef
+        self._leaves = leaves  # dense: list of [n_slots, ...] np arrays
+        self._rows: dict[int, list] = {}  # sparse: id -> leaf rows
+        self._default_row = default_row  # () -> row tree (sparse mode)
+        self._default_leaves = None  # cached flattened default rows
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------ constructors
+    @classmethod
+    def from_stacked(cls, tree) -> "PopulationStore":
+        leaves, treedef = _tree_flatten(tree)
+        leaves = [np.asarray(x) for x in leaves]
+        n_slots = leaves[0].shape[0] if leaves else 0
+        return cls(n_slots=n_slots, leaves=leaves, treedef=treedef)
+
+    @classmethod
+    def lazy(cls, default_row, n_slots: int) -> "PopulationStore":
+        """Sparse store: ``default_row()`` builds one slot's fresh row
+        tree (host numpy); rows materialize on writeback only."""
+        row_leaves, treedef = _tree_flatten(default_row())
+        store = cls(
+            n_slots=n_slots,
+            leaves=None,
+            treedef=treedef,
+            default_row=default_row,
+        )
+        store._default_leaves = [np.array(x) for x in row_leaves]
+        return store
+
+    # ------------------------------------------------------------ access
+    @property
+    def nbytes(self) -> int:
+        """Resident host bytes (dense leaves + materialized sparse rows)."""
+        total = 0
+        if self._leaves is not None:
+            total += sum(x.nbytes for x in self._leaves)
+        for row in self._rows.values():
+            total += sum(x.nbytes for x in row)
+        return total
+
+    @property
+    def row_nbytes(self) -> int:
+        """Bytes of ONE slot's row — the per-client unit the bench's
+        analytic memory curves multiply out."""
+        if self._leaves is not None:
+            return sum(
+                x.nbytes // max(1, x.shape[0]) for x in self._leaves
+            )
+        return sum(x.nbytes for x in self._default_leaves)
+
+    def fetch(self, ids) -> object:
+        """The ``[len(ids), ...]`` cohort rows as a host tree (fresh
+        arrays — safe to hand to ``device_put``)."""
+        ids = np.asarray(ids, np.int64)
+        with self._lock:
+            if self._leaves is not None:
+                return _tree_unflatten(
+                    self._treedef, [x[ids] for x in self._leaves]
+                )
+            stacks: list[list] = [[] for _ in self._default_leaves]
+            for worker_id in ids:
+                row = self._rows.get(int(worker_id), self._default_leaves)
+                for i, leaf in enumerate(row):
+                    stacks[i].append(leaf)
+            return _tree_unflatten(
+                self._treedef, [np.stack(s) for s in stacks]
+            )
+
+    def writeback(self, ids, tree) -> None:
+        """Write the cohort's updated rows under their worker ids.
+        Duplicate ids resolve last-writer-wins (the OBD cohort pads with
+        DISTINCT ids precisely so this never matters)."""
+        ids = np.asarray(ids, np.int64)
+        leaves, _ = _tree_flatten(tree)
+        leaves = [np.asarray(x) for x in leaves]
+        with self._lock:
+            if self._leaves is not None:
+                for stored, new in zip(self._leaves, leaves):
+                    stored[ids] = new
+                return
+            for pos, worker_id in enumerate(ids):
+                self._rows[int(worker_id)] = [
+                    np.array(leaf[pos]) for leaf in leaves
+                ]
+
+    def materialized_ids(self) -> list[int]:
+        """Sparse mode: the ids ever written (everything else is still
+        the fresh default row)."""
+        with self._lock:
+            return sorted(self._rows)
+
+    # ------------------------------------------------- npz persistence
+    def save(self, directory: str, *, chunk_slots: int = 4096,
+             tag: int | None = None) -> str:
+        """Persist to ``directory`` as npz chunks + a manifest.
+
+        Chunks are written atomically (tmp + rename) and the manifest
+        LAST, so a kill mid-save leaves either the previous complete
+        store or a manifest whose chunks all exist — the resume
+        contract's durable-or-absent rule.  ``tag`` pins the save to a
+        round/aggregate key (the OBD opt-state ``stat_key`` contract).
+        Sharded-per-host layout: on a multi-process pod each host saves
+        only its ``host_slot_range`` slice; single-process saves all."""
+        os.makedirs(directory, exist_ok=True)
+        lo, hi = self.host_slot_range(self.n_slots)
+        chunk_paths = []
+        for start in range(lo, hi, chunk_slots):
+            stop = min(start + chunk_slots, hi)
+            ids = np.arange(start, stop)
+            tree = self.fetch(ids)
+            leaves, _ = _tree_flatten(tree)
+            payload = {f"leaf_{i}": leaf for i, leaf in enumerate(leaves)}
+            payload["slot_lo"] = np.int64(start)
+            payload["slot_hi"] = np.int64(stop)
+            name = f"pop_{start:08d}_{stop:08d}.npz"
+            path = os.path.join(directory, name)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                np.savez(f, **payload)
+            os.replace(tmp, path)
+            chunk_paths.append(name)
+        manifest = {
+            "version": STORE_VERSION,
+            "n_slots": self.n_slots,
+            "chunk_slots": int(chunk_slots),
+            "chunks": chunk_paths,
+            "slot_range": [int(lo), int(hi)],
+            "tag": None if tag is None else int(tag),
+        }
+        manifest_path = os.path.join(directory, _MANIFEST)
+        tmp = manifest_path + ".tmp"
+        with open(tmp, "w", encoding="utf8") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+        os.replace(tmp, manifest_path)
+        return directory
+
+    @classmethod
+    def load(cls, directory: str, *, default_row=None,
+             expect_tag: int | None = None) -> "PopulationStore | None":
+        """Restore a saved store, or None when absent/torn/mismatched —
+        the ``util/resume.py`` contract: a torn save is a WARNING and a
+        fresh-state fallback, never a crash."""
+        manifest_path = os.path.join(directory, _MANIFEST)
+        try:
+            with open(manifest_path, encoding="utf8") as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if manifest.get("version") != STORE_VERSION:
+            get_logger().warning(
+                "population store at %s has version %r (want %d) — "
+                "starting from fresh state",
+                directory, manifest.get("version"), STORE_VERSION,
+            )
+            return None
+        if expect_tag is not None and manifest.get("tag") != expect_tag:
+            get_logger().warning(
+                "population store at %s is tagged %r, resume point wants"
+                " %d — starting from fresh state",
+                directory, manifest.get("tag"), expect_tag,
+            )
+            return None
+        n_slots = int(manifest["n_slots"])
+        lo, hi = manifest.get("slot_range", [0, n_slots])
+        loaded_leaves = None
+        treedef = None
+        try:
+            for name in manifest["chunks"]:
+                with np.load(os.path.join(directory, name)) as blob:
+                    start = int(blob["slot_lo"])
+                    stop = int(blob["slot_hi"])
+                    rows = [
+                        blob[f"leaf_{i}"]
+                        for i in range(
+                            len(
+                                [
+                                    k
+                                    for k in blob.files
+                                    if k.startswith("leaf_")
+                                ]
+                            )
+                        )
+                    ]
+                if loaded_leaves is None:
+                    loaded_leaves = [
+                        np.zeros(
+                            (hi - lo, *r.shape[1:]), r.dtype
+                        )
+                        for r in rows
+                    ]
+                for i, r in enumerate(rows):
+                    loaded_leaves[i][start - lo : stop - lo] = r
+        except Exception as exc:  # noqa: BLE001 — torn/corrupt chunk set
+            get_logger().warning(
+                "population store at %s is torn (%s) — starting from"
+                " fresh state (the resume contract)",
+                directory, exc,
+            )
+            return None
+        if loaded_leaves is None:
+            return None
+        if default_row is not None:
+            # sparse restore: only rows that differ from the default are
+            # re-materialized, so a restored store keeps the
+            # fresh-init-until-written semantics
+            store = cls.lazy(default_row, n_slots)
+            defaults = store._default_leaves
+            for pos in range(hi - lo):
+                row = [leaf[pos] for leaf in loaded_leaves]
+                if all(
+                    r.shape == d.shape and np.array_equal(r, d)
+                    for r, d in zip(row, defaults)
+                ):
+                    continue
+                store._rows[lo + pos] = [np.array(r) for r in row]
+            return store
+        # dense restore needs a treedef — rebuild a flat dict tree
+        import jax
+
+        tree = {f"leaf_{i}": leaf for i, leaf in enumerate(loaded_leaves)}
+        leaves, treedef = jax.tree.flatten(tree)
+        return cls(n_slots=n_slots, leaves=leaves, treedef=treedef)
+
+    @staticmethod
+    def host_slot_range(n_slots: int) -> tuple[int, int]:
+        """This process's contiguous slot slice under the
+        sharded-per-host layout (the whole range single-process)."""
+        import jax
+
+        count = jax.process_count()
+        if count <= 1:
+            return 0, n_slots
+        index = jax.process_index()
+        per = (n_slots + count - 1) // count
+        return min(index * per, n_slots), min((index + 1) * per, n_slots)
+
+
+@dataclass
+class PrefetchStats:
+    """What one cohort placement cost: total fetch+place wall, the
+    portion the session thread actually BLOCKED on (exposed — what the
+    double buffer exists to hide), payload bytes, and whether the fetch
+    had been scheduled ahead (False = cold/synchronous warmup)."""
+
+    seconds: float
+    exposed: float
+    nbytes: int
+    prefetched: bool
+
+
+class CohortPrefetcher:
+    """Double-buffered cohort fetch + device placement on a background
+    thread.  ``schedule(key, ids)`` starts the transfer; ``take(key,
+    ids)`` blocks only for whatever has not already landed.  A take with
+    no matching schedule (the first round, or an ids mismatch — which
+    cannot happen for deterministic selection but is checked anyway)
+    degrades to a synchronous fetch, reported as non-prefetched so the
+    telemetry can mark it warmup."""
+
+    def __init__(self, fetch_fn, depth: int = 2):
+        #: fetch_fn(ids) -> (placed_device_tree, payload_nbytes)
+        self._fetch = fetch_fn
+        self._depth = max(1, int(depth))
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="cohort-prefetch"
+        )
+        self._inflight: dict[object, tuple[Future, np.ndarray]] = {}
+
+    def _job(self, ids):
+        start = time.monotonic()
+        placed, nbytes = self._fetch(ids)
+        return placed, nbytes, time.monotonic() - start
+
+    def schedule(self, key, ids) -> None:
+        if key in self._inflight or len(self._inflight) >= self._depth:
+            return
+        ids = np.asarray(ids)
+        self._inflight[key] = (self._pool.submit(self._job, ids), ids)
+
+    def take(self, key, ids) -> tuple[object, PrefetchStats]:
+        ids = np.asarray(ids)
+        entry = self._inflight.pop(key, None)
+        if entry is not None and np.array_equal(entry[1], ids):
+            blocked_from = time.monotonic()
+            placed, nbytes, seconds = entry[0].result()
+            exposed = time.monotonic() - blocked_from
+            return placed, PrefetchStats(
+                seconds=seconds,
+                exposed=exposed,
+                nbytes=nbytes,
+                prefetched=True,
+            )
+        if entry is not None:
+            get_logger().warning(
+                "cohort prefetch for %r was scheduled with different ids"
+                " — refetching synchronously", key,
+            )
+            entry[0].cancel()
+        start = time.monotonic()
+        placed, nbytes = self._fetch(ids)
+        seconds = time.monotonic() - start
+        return placed, PrefetchStats(
+            seconds=seconds, exposed=seconds, nbytes=nbytes,
+            prefetched=False,
+        )
+
+    def close(self) -> None:
+        for future, _ids in self._inflight.values():
+            future.cancel()
+        self._inflight.clear()
+        self._pool.shutdown(wait=True)
+
+
+class WritebackQueue:
+    """Asynchronous device→host writeback into a :class:`PopulationStore`.
+
+    ``submit`` snapshots the device rows by reference and returns; the
+    worker fetches (``jax.device_get``) and writes them back while the
+    next round runs.  ``drain`` joins everything pending — called before
+    a save (durability) and at session exit.  Completed-job timings
+    accumulate host-side and are collected by the SESSION thread
+    (``pop_completed``) so the trace recorder is never touched from the
+    worker."""
+
+    def __init__(self, store: PopulationStore):
+        self._store = store
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="cohort-writeback"
+        )
+        self._pending: list[Future] = []
+        self._completed: list[dict] = []
+        self._lock = threading.Lock()
+
+    def _job(self, ids, device_tree, meta):
+        import jax
+
+        start = time.monotonic()
+        host_tree = jax.device_get(device_tree)
+        self._store.writeback(ids, host_tree)
+        record = dict(meta)
+        record["seconds"] = time.monotonic() - start
+        with self._lock:
+            self._completed.append(record)
+
+    def submit(self, ids, device_tree, **meta) -> None:
+        ids = np.asarray(ids)
+        self._pending = [f for f in self._pending if not f.done()]
+        self._pending.append(
+            self._pool.submit(self._job, ids, device_tree, meta)
+        )
+
+    def drain(self) -> None:
+        pending, self._pending = self._pending, []
+        for future in pending:
+            future.result()  # surface worker errors loudly
+
+    def pop_completed(self) -> list[dict]:
+        with self._lock:
+            done, self._completed = self._completed, []
+        return done
+
+    def close(self) -> None:
+        self.drain()
+        self._pool.shutdown(wait=True)
+
+
+def union_cohort(id_rows: np.ndarray, pad_to: int) -> tuple[np.ndarray, np.ndarray]:
+    """The fused-horizon cohort rule: ``id_rows`` is the ``[H, S_pad]``
+    per-round selected-id matrix; the chunk fetches the UNION of those
+    ids once.  Returns ``(union_ids [pad_to], pos_rows [H, S_pad])``
+    where ``pos_rows`` maps each round's slot to its row in the placed
+    union stack.  The union is padded to the static ``pad_to`` with
+    duplicate rows (never referenced by ``pos_rows``) so every chunk of
+    the same horizon length shares one program shape — zero retraces."""
+    id_rows = np.asarray(id_rows)
+    union, inverse = np.unique(id_rows, return_inverse=True)
+    if len(union) > pad_to:
+        raise ValueError(
+            f"union cohort of {len(union)} ids exceeds pad_to={pad_to}"
+        )
+    pos_rows = inverse.reshape(id_rows.shape).astype(np.int32)
+    union_ids = np.concatenate(
+        [union, np.full(pad_to - len(union), union[0], union.dtype)]
+    ).astype(np.int32)
+    return union_ids, pos_rows

@@ -39,6 +39,27 @@ for exec_mode in sequential spmd; do
     ++fed_avg.save_dir=$TRACE_SMOKE/$exec_mode $extra
 done
 
+# streamed-population smoke (util/population.py): the host-offloaded
+# per-client store with double-buffered cohort prefetch, fused over a
+# 4-round horizon (8 rounds = 2 chunks, so the second chunk's cohort is
+# a real non-warmup prefetch scheduled behind the first chunk's
+# dispatch).  The trace must hold the fused dispatch budget with zero
+# retraces AND keep the exposed prefetch wall under 10% — the transfer
+# hides behind compute (the tentpole's overlap gate).
+run --config-name fed_avg/mnist.yaml \
+  ++fed_avg.round=8 ++fed_avg.epoch=1 ++fed_avg.worker_number=8 \
+  ++fed_avg.executor=spmd \
+  ++fed_avg.algorithm_kwargs.population_store=streamed \
+  ++fed_avg.algorithm_kwargs.random_client_number=4 \
+  ++fed_avg.algorithm_kwargs.round_horizon=4 \
+  ++fed_avg.dataset_kwargs.train_size=256 ++fed_avg.dataset_kwargs.test_size=64 \
+  ++fed_avg.telemetry.enabled=True \
+  ++fed_avg.save_dir=$TRACE_SMOKE/streamed
+python3 -m tools.tracedump "$TRACE_SMOKE/streamed/server/trace.jsonl" \
+  --assert-budget "dispatches_per_round<=1" \
+  --assert-budget "retrace_events==0" \
+  --assert-budget "prefetch_exposed_fraction<=0.1"
+
 # fault-injection smoke (util/faults.py): a seeded FaultPlan drops ~30% of
 # clients per round and corrupts one upload; the update guard must reject
 # the poison, the quorum must hold, and the run must finish — on BOTH

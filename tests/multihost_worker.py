@@ -1,8 +1,20 @@
-"""Subprocess body for the 2-process DCN dryrun (run by
-``test_multihost.py``, not by pytest directly): joins a 2-process
+"""Subprocess body for the multi-host DCN dryrun (run by
+``test_multihost.py``, not by pytest directly): joins a
 jax.distributed CPU cluster through ``initialize_multihost``, builds the
 global mesh, and drives ONE full SPMD FedAvg round with client data placed
-via ``put_sharded`` across process boundaries."""
+via ``put_sharded`` across process boundaries.
+
+Two harness shapes, same 8-device global mesh:
+
+* 2 processes × 4 forced host devices — the real cross-process cluster
+  (collectives ride the distributed runtime the way DCN traffic would);
+* 1 process × 8 forced host devices — the EMULATED fallback for
+  containers whose CPU backend cannot run multi-process computations:
+  ``initialize_multihost`` still joins a (1-process) coordinator, and the
+  fedavg/fsdp modes build the mesh through ``create_hybrid_device_mesh``
+  with ``virtual_hosts=2`` so the (hosts × chips) hybrid layout executes
+  end-to-end (virtual blocks preserve device order — bit-identical
+  artifacts to the flat ``make_mesh`` reference)."""
 
 import os
 import sys
@@ -15,7 +27,10 @@ def main() -> int:
     save_dir = sys.argv[4]
     mode = sys.argv[5] if len(sys.argv) > 5 else "fedavg"
 
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    per_process = 8 // num_processes
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={per_process}"
+    )
     os.environ["PALLAS_AXON_POOL_IPS"] = ""  # keep the axon platform out
     import jax
 
@@ -23,6 +38,7 @@ def main() -> int:
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from distributed_learning_simulator_tpu.parallel.mesh import (
+        create_hybrid_device_mesh,
         initialize_multihost,
         make_mesh,
     )
@@ -31,7 +47,7 @@ def main() -> int:
         DistributedTrainingConfig,
     )
 
-    # the two subprocesses race to the coordinator port; a lost race is a
+    # the subprocesses race to the coordinator port; a lost race is a
     # retry, not a failed dryrun — driven through config exactly as a
     # product bring-up script would (README "Multi-host pods")
     initialize_multihost(
@@ -42,8 +58,8 @@ def main() -> int:
         config=DistributedTrainingConfig(multihost_init_retries=2),
     )
     assert jax.process_count() == num_processes, jax.process_count()
-    assert len(jax.devices()) == 4 * num_processes
-    assert len(jax.local_devices()) == 4
+    assert len(jax.devices()) == 8
+    assert len(jax.local_devices()) == per_process
 
     from distributed_learning_simulator_tpu.config import DistributedTrainingConfig
     from distributed_learning_simulator_tpu.engine.engine import ComputeEngine
@@ -87,8 +103,19 @@ def main() -> int:
         model_ctx, HyperParameter.from_config(config), total_steps=8
     )
     # fsdp: (clients=4, model=2) — P("model")-sharded leaves cross the
-    # process boundary; aggregation reduce_scatters over the model axis
-    mesh = make_mesh(model_parallel=2) if fsdp else make_mesh()
+    # process boundary; aggregation reduce_scatters over the model axis.
+    # Emulated single-process harness: build through the hybrid layout
+    # with 2 virtual hosts so create_hybrid_device_mesh executes end to
+    # end (device order preserved — same grid as make_mesh)
+    if num_processes == 1:
+        mesh = create_hybrid_device_mesh(
+            model_parallel=2 if fsdp else 1, virtual_hosts=2
+        )
+        assert (mesh.devices == (
+            make_mesh(model_parallel=2) if fsdp else make_mesh()
+        ).devices).all()
+    else:
+        mesh = make_mesh(model_parallel=2) if fsdp else make_mesh()
     assert mesh.devices.size == 8
     session = SpmdFedAvgSession(
         config, dataset_collection, model_ctx, engine, practitioners, mesh=mesh

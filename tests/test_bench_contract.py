@@ -186,6 +186,35 @@ def _patch_success(monkeypatch, bench, tmp_path):
             "auto_vs_hand": 1.11,
         },
     )
+    monkeypatch.setattr(
+        bench,
+        "measure_population_scaling",
+        lambda: {
+            "model": "LeNet5/MNIST",
+            "measured_workers": bench.POP_WORKERS,
+            "selected": bench.POP_SELECTED,
+            "device": {
+                "rounds_per_sec": 1.0,
+                "scaling": {
+                    "1000": {"client_state_gb": 0.2, "oom_expected": False},
+                    "1000000": {"client_state_gb": 200.0, "oom_expected": True},
+                },
+            },
+            "streamed": {
+                "rounds_per_sec": 0.95,
+                "scaling": {
+                    "1000": {"client_state_gb": 0.002, "oom_expected": False},
+                    "1000000": {"client_state_gb": 0.002, "oom_expected": False},
+                },
+            },
+            "hbm_growth_1k_to_1m": {"device": 1000.0, "streamed": 1.0},
+            "peak_hbm_flat": 1,
+            "prefetch_overlap_fraction": 0.97,
+            "prefetch_exposed_fraction": 0.03,
+            "retrace_events": 0,
+            "population_path": "streamed",
+        },
+    )
 
 
 def test_bench_main_prints_compact_headline_and_spills_detail(
@@ -220,6 +249,9 @@ def test_bench_main_prints_compact_headline_and_spills_detail(
         "telemetry_overhead_fraction",
         "retrace_events",
         "client_chunk_auto",
+        "population_path",
+        "peak_hbm_flat",
+        "prefetch_overlap_fraction",
         "lint_findings",
         "shardcheck_findings",
         "detail",
@@ -277,6 +309,10 @@ def test_bench_main_prints_compact_headline_and_spills_detail(
         "telemetry",
         "client_chunk_auto",
         "autotune",
+        "population_path",
+        "peak_hbm_flat",
+        "prefetch_overlap_fraction",
+        "population_scaling",
         "lint_findings",
         "shardcheck_findings",
     ):
@@ -337,6 +373,17 @@ def test_bench_main_prints_compact_headline_and_spills_detail(
     assert payload["client_chunk_auto"] == 1.11
     assert payload["autotune"]["winner_chunk"] == 4
     assert "legs_seconds" in payload["autotune"]
+    # streamed populations: the top-level triple mirrors the A/B — the
+    # streamed watermark held FLAT 1k→1M while the device column grew
+    # linearly (oom_expected at 1M), and the traced streamed run's
+    # prefetch wall hid under the round span
+    assert payload["population_path"] == "streamed"
+    assert payload["peak_hbm_flat"] == 1
+    assert payload["prefetch_overlap_fraction"] == 0.97
+    pop = payload["population_scaling"]
+    assert pop["device"]["scaling"]["1000000"]["oom_expected"] is True
+    assert pop["streamed"]["scaling"]["1000000"]["oom_expected"] is False
+    assert pop["hbm_growth_1k_to_1m"]["streamed"] <= 1.10
     # analyzer health: the audited jaxlint finding count (count only —
     # the per-finding detail lives in the analyzer's own JSON output)
     assert payload["lint_findings"] == 38
@@ -368,6 +415,7 @@ def test_bench_main_survives_measurement_failures(monkeypatch, tmp_path):
     monkeypatch.setattr(bench, "measure_buffered_aggregation", boom)
     monkeypatch.setattr(bench, "measure_telemetry", boom)
     monkeypatch.setattr(bench, "measure_autotune", boom)
+    monkeypatch.setattr(bench, "measure_population_scaling", boom)
     monkeypatch.setattr(bench, "measure_lint", boom)
     monkeypatch.setattr(bench, "measure_shardcheck", boom)
     out = io.StringIO()
@@ -431,6 +479,12 @@ def test_bench_main_survives_measurement_failures(monkeypatch, tmp_path):
     # autotune degrades to an error marker + -1 top-level field
     assert "error" in payload["autotune"]
     assert payload["client_chunk_auto"] == -1.0
+    # population A/B degrades to an error marker; the top-level triple
+    # degrades to the device default / -1, never a missing field
+    assert "error" in payload["population_scaling"]
+    assert payload["population_path"] == "device"
+    assert payload["peak_hbm_flat"] == -1
+    assert payload["prefetch_overlap_fraction"] == -1.0
     # lint count degrades to -1 (never a missing field, never a crash)
     assert payload["lint_findings"] == -1
     # shardcheck count degrades the same way (-1/absent-never)
@@ -460,6 +514,9 @@ def test_headline_line_drops_fields_rather_than_truncating(monkeypatch):
         "telemetry_overhead_fraction": 0.01,
         "retrace_events": 0,
         "client_chunk_auto": 1.0,
+        "population_path": "streamed",
+        "peak_hbm_flat": 1,
+        "prefetch_overlap_fraction": 0.97,
         "lint_findings": 38,
         "shardcheck_findings": 0,
     }

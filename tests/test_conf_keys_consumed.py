@@ -55,6 +55,12 @@ CONSUMERS: dict[tuple[str, str], list[str]] = {
         "parallel/spmd.py",
         "parallel/spmd_obd.py",
     ],
+    ("algorithm_kwargs", "population_store"): [
+        "parallel/spmd.py",
+        "parallel/spmd_obd.py",
+        "util/population.py",
+    ],
+    ("algorithm_kwargs", "hybrid_mesh_hosts"): ["training.py"],
     ("algorithm_kwargs", "aggregation_mode"): [
         "util/buffered.py",
         "server/aggregation_server.py",

@@ -1,0 +1,576 @@
+"""Streamed populations (``algorithm_kwargs.population_store: streamed``):
+the host-offloaded per-client state store, the double-buffered cohort
+prefetcher, and their session wiring (util/population.py + the FedAvg
+family's streamed round path).
+
+The acceptance contract mirrors selection gather's (PR 3): streaming is a
+pure PLACEMENT change — the cohort-shaped programs are the same
+shape-polymorphic dense programs traced at ``s_pad`` and the per-client
+rng streams are fold_in-indexed by worker id, so the trajectory must be
+bit-identical to the device-resident path, per-round and fused-horizon,
+composing with dropout weight rows and the OBD phase programs.  On top of
+that sit the streamed-only contracts: writeback durability across a
+kill/resume (via the ``util/resume.py`` torn-store rules), never-selected
+clients keeping fresh-init state in the sparse opt store, and LOUD
+rejection wherever the knob cannot apply.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from conftest import fed_avg_config
+from distributed_learning_simulator_tpu.parallel.mesh import (
+    broadcast_selection_rows,
+    create_hybrid_device_mesh,
+    make_mesh,
+)
+from distributed_learning_simulator_tpu.training import (
+    _build_task,
+    train,
+    train_with_recovery,
+)
+from distributed_learning_simulator_tpu.util.population import (
+    CohortPrefetcher,
+    PopulationStore,
+    WritebackQueue,
+    union_cohort,
+)
+
+
+# ---------------------------------------------------------------------------
+# fast unit layer: the store / prefetcher / cohort primitives
+
+
+def test_dense_store_fetch_writeback_roundtrip():
+    tree = {
+        "w": np.arange(24, dtype=np.float32).reshape(6, 4),
+        "b": np.arange(6, dtype=np.int32),
+    }
+    store = PopulationStore.from_stacked(tree)
+    assert store.n_slots == 6
+    got = store.fetch([4, 1])
+    np.testing.assert_array_equal(got["w"], tree["w"][[4, 1]])
+    np.testing.assert_array_equal(got["b"], tree["b"][[4, 1]])
+    # fetch returns fresh arrays — mutating them must not leak back
+    got["w"][:] = -1.0
+    assert store.fetch([4])["w"][0, 0] == 16.0
+    store.writeback([1, 3], {"w": np.zeros((2, 4), np.float32), "b": np.array([7, 8], np.int32)})
+    np.testing.assert_array_equal(store.fetch([1])["w"][0], np.zeros(4))
+    assert store.fetch([3])["b"][0] == 8
+    assert store.row_nbytes == 4 * 4 + 4
+    assert store.nbytes == 6 * store.row_nbytes
+
+
+def test_sparse_store_never_written_is_fresh_init():
+    """The lazy-store contract the OBD opt population rides: an id that
+    was never written fetches the default row, and only written ids are
+    materialized (host RAM scales with participants, not population)."""
+    default = {"m": np.full((3,), 0.5, np.float32), "count": np.int32(0)}
+    store = PopulationStore.lazy(lambda: default, n_slots=1_000_000)
+    assert store.materialized_ids() == []
+    got = store.fetch([0, 999_999])
+    np.testing.assert_array_equal(got["m"], np.broadcast_to(0.5, (2, 3)))
+    store.writeback([7], {"m": np.ones((1, 3), np.float32), "count": np.array([4], np.int32)})
+    assert store.materialized_ids() == [7]
+    mixed = store.fetch([7, 8])
+    np.testing.assert_array_equal(mixed["m"][0], np.ones(3))
+    np.testing.assert_array_equal(mixed["m"][1], np.full(3, 0.5))
+    assert mixed["count"][0] == 4 and mixed["count"][1] == 0
+    # nbytes counts materialized rows only — the million-slot store did
+    # not allocate a million rows
+    assert store.nbytes == store.row_nbytes
+
+
+def test_store_save_load_roundtrip_and_tag(tmp_path):
+    tree = {"w": np.random.default_rng(0).normal(size=(10, 3)).astype(np.float32)}
+    store = PopulationStore.from_stacked(tree)
+    directory = str(tmp_path / "pop")
+    store.save(directory, chunk_slots=4, tag=3)
+    assert len(glob.glob(os.path.join(directory, "pop_*.npz"))) == 3
+    loaded = PopulationStore.load(directory, expect_tag=3)
+    assert loaded is not None and loaded.n_slots == 10
+    (leaf,) = loaded.fetch(np.arange(10)).values()
+    np.testing.assert_array_equal(leaf, tree["w"])
+    # wrong tag / absent directory -> None (fresh-state fallback), never a
+    # crash — the util/resume.py durable-or-absent rule
+    assert PopulationStore.load(directory, expect_tag=4) is None
+    assert PopulationStore.load(str(tmp_path / "missing")) is None
+
+
+def test_store_torn_chunk_loads_as_none(tmp_path):
+    store = PopulationStore.from_stacked({"w": np.ones((8, 2), np.float32)})
+    directory = str(tmp_path / "pop")
+    store.save(directory, chunk_slots=4, tag=1)
+    chunk = sorted(glob.glob(os.path.join(directory, "pop_*.npz")))[0]
+    with open(chunk, "wb") as f:
+        f.write(b"not an npz")
+    assert PopulationStore.load(directory, expect_tag=1) is None
+    # torn MANIFEST (killed mid-json) is equally a fresh-state fallback
+    manifest = os.path.join(directory, "population_manifest.json")
+    with open(manifest, "w", encoding="utf8") as f:
+        f.write('{"version": 1, "n_slo')
+    assert PopulationStore.load(directory) is None
+
+
+def test_sparse_restore_rematerializes_only_nondefault_rows(tmp_path):
+    default = {"m": np.zeros((2,), np.float32)}
+    store = PopulationStore.lazy(lambda: default, n_slots=6)
+    store.writeback([2], {"m": np.array([[1.0, 2.0]], np.float32)})
+    directory = str(tmp_path / "opt")
+    store.save(directory, tag=2)
+    restored = PopulationStore.load(directory, default_row=lambda: default, expect_tag=2)
+    assert restored is not None
+    # rows equal to the default stay UNmaterialized — the restored store
+    # keeps the fresh-init-until-written semantics
+    assert restored.materialized_ids() == [2]
+    np.testing.assert_array_equal(restored.fetch([2])["m"][0], [1.0, 2.0])
+
+
+def test_union_cohort_positions_and_padding():
+    id_rows = np.array([[3, 5, 3], [5, 9, 3]], np.int32)
+    union_ids, pos_rows = union_cohort(id_rows, pad_to=5)
+    np.testing.assert_array_equal(union_ids, [3, 5, 9, 3, 3])
+    # every (round, slot) position indexes its id's row in the union
+    np.testing.assert_array_equal(union_ids[pos_rows], id_rows)
+    assert pos_rows.dtype == np.int32
+    with pytest.raises(ValueError, match="exceeds pad_to"):
+        union_cohort(np.array([[0, 1], [2, 3]]), pad_to=3)
+
+
+def test_prefetcher_overlap_and_mismatch_fallback():
+    calls = []
+
+    def fetch(ids):
+        calls.append(np.asarray(ids).copy())
+        return {"ids": np.asarray(ids)}, int(np.asarray(ids).nbytes)
+
+    prefetcher = CohortPrefetcher(fetch)
+    try:
+        # cold take (no schedule): synchronous, reported non-prefetched —
+        # the telemetry's warmup marker
+        placed, stats = prefetcher.take(1, np.array([0, 1]))
+        assert not stats.prefetched and stats.exposed == stats.seconds
+        np.testing.assert_array_equal(placed["ids"], [0, 1])
+        # scheduled take: the background fetch is reused
+        prefetcher.schedule(2, np.array([2, 3]))
+        placed, stats = prefetcher.take(2, np.array([2, 3]))
+        assert stats.prefetched and stats.nbytes == 16
+        np.testing.assert_array_equal(placed["ids"], [2, 3])
+        # ids mismatch (cannot happen for deterministic selection, but
+        # checked anyway): refetch synchronously, never serve stale rows
+        prefetcher.schedule(3, np.array([4, 5]))
+        placed, stats = prefetcher.take(3, np.array([6, 7]))
+        assert not stats.prefetched
+        np.testing.assert_array_equal(placed["ids"], [6, 7])
+    finally:
+        prefetcher.close()
+
+
+def test_writeback_queue_drains_and_reports_timings():
+    store = PopulationStore.from_stacked({"w": np.zeros((4, 2), np.float32)})
+    queue = WritebackQueue(store)
+    try:
+        queue.submit(np.array([1, 2]), {"w": np.ones((2, 2), np.float32)}, round=5)
+        queue.drain()
+        np.testing.assert_array_equal(store.fetch([1, 2])["w"], np.ones((2, 2)))
+        np.testing.assert_array_equal(store.fetch([0])["w"], np.zeros((1, 2)))
+        (record,) = queue.pop_completed()
+        assert record["round"] == 5 and record["seconds"] >= 0.0
+        assert queue.pop_completed() == []
+    finally:
+        queue.close()
+
+
+def test_broadcast_selection_rows_single_process_noop():
+    rows = np.arange(6).reshape(2, 3)
+    np.testing.assert_array_equal(broadcast_selection_rows(rows), rows)
+
+
+def test_hybrid_mesh_virtual_hosts_matches_flat_grid():
+    """The CI seam: ``virtual_hosts`` carves contiguous per-host blocks
+    that preserve device order, so the hybrid grid is bit-identical to
+    ``make_mesh``'s — the emulated multihost harness depends on it."""
+    for model_parallel in (1, 2):
+        hybrid = create_hybrid_device_mesh(
+            model_parallel=model_parallel, virtual_hosts=2
+        )
+        flat = make_mesh(model_parallel=model_parallel)
+        assert hybrid.axis_names == ("clients", "model")
+        assert (hybrid.devices == flat.devices).all()
+    with pytest.raises(AssertionError):
+        create_hybrid_device_mesh(virtual_hosts=3)  # 8 % 3 != 0
+
+
+def test_calibration_key_pins_population_store():
+    """A calibration taken on the device-resident layout must NEVER
+    silently hit on the streamed one (different chunking trade-off)."""
+    from distributed_learning_simulator_tpu.util.calibration import (
+        calibration_key,
+    )
+
+    common = dict(
+        session="SpmdFedAvgSession",
+        model_name="LeNet5",
+        mesh_shape={"clients": 8, "model": 1},
+        n_slots=8,
+        s_pad=8,
+        batch_size=16,
+    )
+    device_key = calibration_key(**common)
+    streamed_key = calibration_key(**common, population_store="streamed")
+    assert device_key.endswith("|pop=device")
+    assert streamed_key.endswith("|pop=streamed")
+    assert device_key != streamed_key
+
+
+def test_capability_gates_reject_unsupported_sessions():
+    """The knob is implemented on the client-axis FedAvg family; every
+    other layout must reject it with a reason (consumed by
+    tools/shardcheck's conf validator) instead of silently keeping state
+    resident."""
+    from distributed_learning_simulator_tpu.parallel.spmd import (
+        SpmdFedAvgSession,
+        SpmdSignSGDSession,
+    )
+    from distributed_learning_simulator_tpu.parallel.spmd_ep import (
+        SpmdExpertParallelSession,
+    )
+    from distributed_learning_simulator_tpu.parallel.spmd_obd import (
+        SpmdFedOBDSession,
+    )
+    from distributed_learning_simulator_tpu.parallel.spmd_pp import (
+        SpmdPipelineSession,
+    )
+    from distributed_learning_simulator_tpu.parallel.spmd_sparse import (
+        SpmdFedDropoutAvgSession,
+        SpmdSMAFDSession,
+    )
+
+    supported = (SpmdFedAvgSession, SpmdSignSGDSession, SpmdFedOBDSession)
+    for cls in supported:
+        assert cls.capability_gates()["population_store"] is None, cls
+    unsupported = (
+        SpmdFedDropoutAvgSession,
+        SpmdSMAFDSession,
+        SpmdExpertParallelSession,
+        SpmdPipelineSession,
+    )
+    for cls in unsupported:
+        reason = cls.capability_gates()["population_store"]
+        assert reason, cls
+        assert cls.__name__ in reason
+
+
+# ---------------------------------------------------------------------------
+# session layer: parity, durability, and loud runtime rejection (heavy e2e
+# — excluded from the tier-1 budget, still run in a plain `pytest tests/`)
+
+
+def _pop_config(store, save_dir, rounds=3, horizon=1, k=4, workers=8, **overrides):
+    """The proven streamed-parity recipe: 8 workers on the 8-device test
+    mesh (one slot per device — see the bit-exactness note in
+    test_selection_gather.py), an active 4-of-8 selection, tiny MNIST."""
+    algorithm_kwargs = dict(overrides.pop("algorithm_kwargs", {}))
+    algorithm_kwargs["population_store"] = store
+    if k is not None:
+        algorithm_kwargs.setdefault("random_client_number", k)
+    if horizon != 1:
+        algorithm_kwargs["round_horizon"] = horizon
+    config = fed_avg_config(
+        executor="spmd",
+        worker_number=workers,
+        round=rounds,
+        batch_size=16,
+        epoch=1,
+        dataset_kwargs={"train_size": 128, "val_size": 16, "test_size": 32},
+        algorithm_kwargs=algorithm_kwargs,
+        save_dir=save_dir,
+        log_file=os.path.join(save_dir, "run.log"),
+        **overrides,
+    )
+    config.load_config_and_process()
+    return config
+
+
+def _final_params(save_dir, round_number):
+    path = os.path.join(save_dir, "aggregated_model", f"round_{round_number}.npz")
+    with np.load(path) as blob:
+        return {k: blob[k] for k in blob.files}
+
+
+def _assert_bit_exact(device, streamed, device_dir, streamed_dir, rounds):
+    assert set(device["performance"]) == set(streamed["performance"])
+    for rn in sorted(device["performance"]):
+        a, b = device["performance"][rn], streamed["performance"][rn]
+        assert a["test_accuracy"] == b["test_accuracy"], (rn, a, b)
+        assert a["test_loss"] == b["test_loss"], (rn, a, b)
+    pa = _final_params(device_dir, rounds)
+    pb = _final_params(streamed_dir, rounds)
+    assert pa.keys() == pb.keys()
+    for key in pa:
+        np.testing.assert_array_equal(pa[key], pb[key], err_msg=key)
+
+
+@pytest.mark.slow
+def test_streamed_vs_device_bit_exact_per_round(tmp_session_dir):
+    """The acceptance pin, H=1: the streamed path trains the placed
+    s_pad=8 cohort (4 selected + padding) from host-fetched rows and must
+    reproduce the device-resident trajectory bit-exactly."""
+    device = train(_pop_config("device", "dev"))
+    streamed = train(_pop_config("streamed", "str"))
+    _assert_bit_exact(device, streamed, "dev", "str", rounds=3)
+
+
+@pytest.mark.slow
+def test_streamed_vs_gather_bit_exact(tmp_session_dir):
+    """Streaming vs the device-resident GATHER path: both run the same
+    s_pad-shaped program over the same fold_in-by-id rng rows — the
+    placement (host fetch vs device take) is the only difference."""
+    gathered = train(
+        _pop_config(
+            "device", "gat", algorithm_kwargs={"selection_gather": True}
+        )
+    )
+    streamed = train(_pop_config("streamed", "sg"))
+    _assert_bit_exact(gathered, streamed, "gat", "sg", rounds=3)
+
+
+@pytest.mark.slow
+def test_streamed_fused_horizon_union_cohort_parity(tmp_session_dir):
+    """H=4 round fusion: the chunk places ONE union cohort for its
+    [H, S_pad] id matrix and the in-program position rows re-select each
+    round's slots — bit-exact vs the device-resident fused path."""
+    device = train(_pop_config("device", "dh", rounds=4, horizon=4))
+    streamed = train(_pop_config("streamed", "sh", rounds=4, horizon=4))
+    _assert_bit_exact(device, streamed, "dh", "sh", rounds=4)
+
+
+@pytest.mark.slow
+def test_streamed_dropout_weight_rows_parity(tmp_session_dir):
+    """Fault-tolerance dropout rides the host-built weight rows on both
+    paths (a dropped client's padded row contributes exact zeros), so the
+    composed trajectory stays bit-exact."""
+    faults = {"dropout_schedule": {2: [0, 5]}}
+    device = train(_pop_config("device", "fd", fault_tolerance=faults))
+    streamed = train(_pop_config("streamed", "fs", fault_tolerance=faults))
+    _assert_bit_exact(device, streamed, "fd", "fs", rounds=3)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("horizon", [1, 3])
+def test_sign_sgd_streamed_parity(horizon, tmp_session_dir):
+    """sign_SGD streams its per-client batch stacks and host-rng rows the
+    same way (votes are small-integer sign sums: exact under the placed
+    cohort)."""
+    rounds = 3 if horizon == 3 else 2
+    common = dict(
+        rounds=rounds,
+        horizon=horizon,
+        distributed_algorithm="sign_SGD",
+        distribute_init_parameters=False,
+    )
+    device = train(_pop_config("device", f"sd{horizon}", **common))
+    streamed = train(_pop_config("streamed", f"ss{horizon}", **common))
+    assert set(device["performance"]) == set(streamed["performance"])
+    for rn in sorted(device["performance"]):
+        a, b = device["performance"][rn], streamed["performance"][rn]
+        assert a["test_accuracy"] == b["test_accuracy"], (rn, a, b)
+        assert a["test_loss"] == b["test_loss"], (rn, a, b)
+    for arm in (f"sd{horizon}", f"ss{horizon}"):
+        assert os.path.exists(
+            os.path.join(arm, "server", "best_global_model.npz")
+        )
+    with np.load(os.path.join(f"sd{horizon}", "server", "best_global_model.npz")) as da:
+        dev_params = {k: da[k] for k in da.files}
+    with np.load(os.path.join(f"ss{horizon}", "server", "best_global_model.npz")) as sa:
+        for key in sa.files:
+            np.testing.assert_array_equal(dev_params[key], sa[key], err_msg=key)
+
+
+def _obd_config(store, save_dir, rounds=2, **overrides):
+    algorithm_kwargs = {
+        "population_store": store,
+        "random_client_number": 2,
+        "dropout_rate": 0.3,
+        "second_phase_epoch": 2,
+        "early_stop": False,
+        **overrides.pop("algorithm_kwargs", {}),
+    }
+    config = fed_avg_config(
+        distributed_algorithm="fed_obd",
+        executor="spmd",
+        worker_number=4,
+        round=rounds,
+        batch_size=16,
+        epoch=1,
+        dataset_kwargs={"train_size": 128, "val_size": 16, "test_size": 32},
+        algorithm_kwargs=algorithm_kwargs,
+        endpoint_kwargs={"server": {"weight": 0.01}, "worker": {"weight": 0.01}},
+        save_dir=save_dir,
+        **overrides,
+    )
+    config.load_config_and_process()
+    return config
+
+
+@pytest.mark.slow
+def test_obd_streamed_parity_across_phase_switch(tmp_session_dir):
+    """FedOBD streams BOTH stores (client data + the sparse per-slot opt
+    rows); phase 2 materializes the full population at the switch.  The
+    whole schedule — 2 dropout rounds + 2 tune epochs — must match the
+    device path bit-exactly."""
+    device = train(_obd_config("device", "od"))
+    streamed = train(_obd_config("streamed", "os"))
+    _assert_bit_exact(device, streamed, "od", "os", rounds=4)
+
+
+@pytest.mark.slow
+def test_obd_never_selected_clients_keep_fresh_init_state(tmp_session_dir):
+    """The sparse-store contract at session level: entering phase 2, only
+    clients that participated in a phase-1 round are materialized; a
+    never-selected client's opt row IS the fresh default row."""
+    from distributed_learning_simulator_tpu.parallel.spmd_obd import (
+        SpmdFedOBDSession,
+    )
+
+    config = _obd_config("streamed", str(tmp_session_dir / "fresh"))
+    ctx = _build_task(config)
+    session = SpmdFedOBDSession(
+        ctx.config,
+        ctx.dataset_collection,
+        ctx.model_ctx,
+        ctx.engine,
+        ctx.practitioners,
+    )
+    captured = {}
+    original = session._materialize_streamed_phase2
+
+    def capture_then_materialize():
+        session._writeback.drain()
+        captured["ids"] = session._opt_population.materialized_ids()
+        return original()
+
+    session._materialize_streamed_phase2 = capture_then_materialize
+    session.run()
+    assert captured, "phase 2 never materialized the streamed opt store"
+    touched = set(captured["ids"])
+    # 2 rounds x s_pad=4 cohort rows out of 4 workers: the store holds at
+    # most the union of the two cohorts, never the whole-population dense
+    # buffer the device path carries
+    assert touched <= set(range(session.n_slots))
+    assert len(touched) <= 2 * session.s_pad
+    untouched = sorted(set(range(session.config.worker_number)) - touched)
+    if untouched:
+        import jax
+
+        fresh = jax.tree.leaves(session._fresh_opt_row())
+        for leaf, expected in zip(
+            jax.tree.leaves(session._opt_population.fetch([untouched[0]])),
+            fresh,
+        ):
+            np.testing.assert_array_equal(np.asarray(leaf)[0], expected)
+
+
+@pytest.mark.slow
+def test_obd_streamed_writeback_durable_across_kill_and_resume(tmp_session_dir):
+    """Writeback durability: a run killed after phase-1 round 2 resumes
+    from the npz-chunked opt store (tag == the resume aggregate).  The
+    pin is PARITY UNDER RESUME: the recovered streamed run must match a
+    recovered DEVICE-resident run round for round — if the streamed
+    store had torn or fallen back fresh, its post-resume momentum would
+    diverge from the device path's npz-restored state.  (Post-resume
+    rounds are not compared to an UNINTERRUPTED run: OBD resume
+    re-derives its phase-2 schedule from the replayed aggregates, a
+    pre-existing — and path-independent — continuation semantic.)"""
+    faults = {"kill_after_rounds": [2], "restart_backoff_seconds": 0.0}
+    device = train_with_recovery(
+        _obd_config("device", "kd", rounds=3, fault_tolerance=dict(faults))
+    )
+    streamed = train_with_recovery(
+        _obd_config("streamed", "ks", rounds=3, fault_tolerance=dict(faults))
+    )
+    assert device["recovery"]["restarts"] == 1
+    assert streamed["recovery"]["restarts"] == 1
+    # the resume point's store landed durably before the kill
+    assert os.path.exists(
+        os.path.join(
+            "ks", "aggregated_model", "opt_population",
+            "population_manifest.json",
+        )
+    )
+    assert set(device["performance"]) == set(streamed["performance"])
+    for rn in sorted(device["performance"]):
+        a, b = device["performance"][rn], streamed["performance"][rn]
+        assert a["test_accuracy"] == b["test_accuracy"], (rn, a, b)
+        assert a["test_loss"] == b["test_loss"], (rn, a, b)
+    # and the pre-kill rounds restored verbatim from the first attempt
+    uninterrupted = train(_obd_config("streamed", "full", rounds=3))
+    for rn in (1, 2):
+        assert (
+            streamed["performance"][rn]["test_loss"]
+            == uninterrupted["performance"][rn]["test_loss"]
+        ), rn
+
+
+@pytest.mark.slow
+def test_obd_streamed_torn_store_falls_back_fresh(tmp_session_dir):
+    """A torn opt-population store at resume (killed mid-save) is a LOUD
+    fresh-state fallback, never a crash: the resumed run restores its
+    round checkpoints verbatim and completes the full schedule."""
+    from distributed_learning_simulator_tpu.util.faults import (
+        SimulatedPreemption,
+    )
+
+    first = _obd_config(
+        "streamed",
+        "torn",
+        rounds=4,
+        fault_tolerance={"kill_after_rounds": [2], "max_restarts": 0},
+    )
+    with pytest.raises(SimulatedPreemption):
+        train(first)
+    store_dir = os.path.join("torn", "aggregated_model", "opt_population")
+    chunks = sorted(glob.glob(os.path.join(store_dir, "pop_*.npz")))
+    assert chunks, "kill landed before the opt store was saved"
+    with open(chunks[0], "wb") as f:
+        f.write(b"torn mid-write")
+
+    resumed = _obd_config(
+        "streamed",
+        "torn_resume",
+        rounds=4,
+        algorithm_kwargs={"resume_dir": first.save_dir},
+    )
+    result = train(resumed)
+    # rounds 1-2 restore verbatim; 3-4 + 2 tune epochs run to completion
+    # on fresh opt rows (the documented fallback semantics)
+    assert set(result["performance"]) == {1, 2, 3, 4, 5, 6}
+    assert result["performance"][3]["phase"] == "block_dropout_rounds"
+    assert result["performance"][5]["phase"] == "epoch_tune"
+
+
+@pytest.mark.slow
+def test_streamed_rejected_loudly_where_unsupported(tmp_session_dir):
+    """Runtime rejection is a raise naming the knob — never a silent
+    device-resident fallback."""
+    smafd = _pop_config(
+        "streamed",
+        "rej_smafd",
+        distributed_algorithm="single_model_afd",
+        algorithm_kwargs={"dropout_rate": 0.3},
+    )
+    with pytest.raises(ValueError, match="population_store"):
+        train(smafd)
+
+    horizon = _obd_config(
+        "streamed", "rej_h", algorithm_kwargs={"round_horizon": 2}
+    )
+    with pytest.raises(ValueError, match="round_horizon"):
+        train(horizon)
+
+    bogus = _pop_config("hostside", "rej_val")
+    with pytest.raises(ValueError, match="population_store"):
+        train(bogus)

@@ -387,12 +387,16 @@ def test_capability_gates_match_runtime_gate_strings():
         "selection_gather": None,
         "update_guard": None,
         "aggregation_mode": None,
+        "population_store": None,
     }
     obd = SpmdFedOBDSession.capability_gates()
     assert obd["round_horizon"] is None
     assert obd["selection_gather"] is None
     assert obd["update_guard"] is None
     assert "round-barriered" in obd["aggregation_mode"]
+    # OBD streams its participation-merged opt rows (H=1); the class
+    # gate is open and the horizon>1 combination rejects at the instance
+    assert obd["population_store"] is None
     pp = SpmdPipelineSession.capability_gates()
     assert pp["round_horizon"] is None
     assert pp["selection_gather"] is None
@@ -400,11 +404,13 @@ def test_capability_gates_match_runtime_gate_strings():
     # last cell of the guard matrix real
     assert pp["update_guard"] is None
     assert "round-barriered" in pp["aggregation_mode"]
+    assert "device-resident" in pp["population_store"]
     smafd = SpmdSMAFDSession.capability_gates()
     assert "builds its own round function" in smafd["round_horizon"]
     assert "builds its own round program" in smafd["selection_gather"]
     assert "builds its own round program" in smafd["update_guard"]
     assert "round-barriered" in smafd["aggregation_mode"]
+    assert "device-resident" in smafd["population_store"]
 
 
 # --------------------------------------------------------- CLI/allowlist

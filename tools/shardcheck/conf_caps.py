@@ -29,6 +29,7 @@ GATED_KNOBS = (
     "selection_gather",
     "update_guard",
     "aggregation_mode",
+    "population_store",
 )
 
 
@@ -151,6 +152,18 @@ def validate_config(config, subject: str) -> list[Finding]:
         flag(
             f"aggregation_mode=buffered on {cls.__name__}:"
             f" {gates['aggregation_mode']} — session __init__ raises"
+        )
+
+    store = str(kwargs.get("population_store", "device") or "device")
+    if store not in ("device", "streamed"):
+        flag(
+            f"population_store={store!r} is not a layout — expected"
+            " 'device' or 'streamed'; session __init__ raises"
+        )
+    elif store == "streamed" and gates.get("population_store"):
+        flag(
+            f"population_store=streamed on {cls.__name__}:"
+            f" {gates['population_store']} — session __init__ raises"
         )
 
     quorum = int(kwargs.get("min_client_quorum", 0) or 0)

@@ -29,7 +29,11 @@ The summary's ``budget`` block is the gate surface:
   after its first compile (the invariant ``tools/shardcheck``'s
   ``dispatch-budget`` rule certifies statically);
 * wire totals (``sent_mb_total``/``received_mb_total``) and fault
-  totals (``rejected_updates_total``/``dropped_clients_total``).
+  totals (``rejected_updates_total``/``dropped_clients_total``);
+* ``prefetch_exposed_fraction`` — streamed populations: the share of
+  (non-warmup) cohort-prefetch wall the session thread was blocked on
+  instead of hiding it under the previous round's span (0.0 with no
+  prefetch spans, so resident traces gate vacuously green).
 """
 
 from __future__ import annotations
@@ -112,6 +116,14 @@ def summarize(records: list[dict]) -> dict[str, Any]:
     rejected = 0.0
     dropped = 0.0
     staleness_vals: list[float] = []
+    prefetch_wall = 0.0
+    prefetch_exposed = 0.0
+    prefetch_count = 0
+    prefetch_warmups = 0
+    prefetch_bytes = 0.0
+    writeback_wall = 0.0
+    writeback_count = 0
+    writeback_bytes = 0.0
     meta: dict = {}
     for record in records:
         ev = record.get("ev")
@@ -127,6 +139,26 @@ def summarize(records: list[dict]) -> dict[str, Any]:
             if kind == "round":
                 sent_mb += float(record.get("sent_mb", 0.0) or 0.0)
                 received_mb += float(record.get("received_mb", 0.0) or 0.0)
+            elif kind == "prefetch":
+                # streamed populations: ``exposed`` is the wall the
+                # session thread actually BLOCKED on the transfer; the
+                # rest of ``dur`` was hidden under the previous round's
+                # span.  Warmup spans (cold first fetch, or a fallback
+                # synchronous refetch) have nothing to hide under and
+                # are excluded from the overlap fraction.
+                prefetch_bytes += float(record.get("bytes", 0) or 0)
+                if record.get("warmup"):
+                    prefetch_warmups += 1
+                else:
+                    prefetch_count += 1
+                    prefetch_wall += float(record.get("dur", 0.0) or 0.0)
+                    prefetch_exposed += float(
+                        record.get("exposed", 0.0) or 0.0
+                    )
+            elif kind == "writeback":
+                writeback_count += 1
+                writeback_wall += float(record.get("dur", 0.0) or 0.0)
+                writeback_bytes += float(record.get("bytes", 0) or 0)
         elif ev == "event":
             events[kind] = events.get(kind, 0) + 1
             if kind == "compile":
@@ -174,6 +206,15 @@ def summarize(records: list[dict]) -> dict[str, Any]:
         "rejected_updates_total": rejected,
         "dropped_clients_total": dropped,
         "stale_updates_total": float(len(staleness_vals)),
+        # streamed populations: fraction of (non-warmup) prefetch wall
+        # the session thread was actually blocked on — 0.0 means every
+        # transfer hid entirely under the previous round's span, and
+        # 0.0 when the trace has no prefetch spans at all (resident
+        # path), so the gate is vacuously green there.
+        "prefetch_exposed_fraction": round(
+            prefetch_exposed / prefetch_wall if prefetch_wall > 0 else 0.0,
+            6,
+        ),
     }
     ordered_staleness = sorted(staleness_vals)
     return {
@@ -190,6 +231,25 @@ def summarize(records: list[dict]) -> dict[str, Any]:
             "p50": _percentile(ordered_staleness, 0.50),
             "p90": _percentile(ordered_staleness, 0.90),
             "max": ordered_staleness[-1] if ordered_staleness else 0.0,
+        },
+        # streamed populations: host→device cohort transfer overlap —
+        # ``hidden_fraction`` is the share of prefetch wall that ran
+        # under the previous round's span (1 − exposed/wall)
+        "overlap": {
+            "prefetch_count": prefetch_count,
+            "prefetch_warmups": prefetch_warmups,
+            "prefetch_wall_s": round(prefetch_wall, 6),
+            "prefetch_exposed_s": round(prefetch_exposed, 6),
+            "prefetch_bytes": prefetch_bytes,
+            "hidden_fraction": round(
+                1.0 - (prefetch_exposed / prefetch_wall)
+                if prefetch_wall > 0
+                else 1.0,
+                6,
+            ),
+            "writeback_count": writeback_count,
+            "writeback_wall_s": round(writeback_wall, 6),
+            "writeback_bytes": writeback_bytes,
         },
     }
 
@@ -314,5 +374,16 @@ def format_text(summary: dict) -> str:
             f"late_merges={staleness['count']} "
             f"p50={staleness['p50']:g} p90={staleness['p90']:g} "
             f"max={staleness['max']:g}"
+        )
+    overlap = summary.get("overlap") or {}
+    if overlap.get("prefetch_count") or overlap.get("prefetch_warmups"):
+        lines.append(
+            "overlap (streamed): "
+            f"prefetches={overlap['prefetch_count']} "
+            f"warmups={overlap['prefetch_warmups']} "
+            f"wall_s={overlap['prefetch_wall_s']:g} "
+            f"exposed_s={overlap['prefetch_exposed_s']:g} "
+            f"hidden_fraction={overlap['hidden_fraction']:g} "
+            f"writebacks={overlap['writeback_count']}"
         )
     return "\n".join(lines)
